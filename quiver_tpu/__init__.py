@@ -1,0 +1,56 @@
+"""quiver_tpu — TPU-native graph-learning data layer.
+
+A ground-up JAX/XLA/Pallas rebuild of the capabilities of
+quiver-team/torch-quiver (reference at ``/root/reference``): k-hop neighbor
+sampling, cached/sharded feature collection, cross-host feature exchange,
+partitioning tools, and a GNN serving pipeline — designed for TPU (static
+shapes, device meshes, XLA collectives) rather than translated from CUDA.
+
+Public API parity map (reference ``srcs/python/quiver/__init__.py:1-21``):
+
+  Feature, DistFeature, PartitionInfo      -> quiver_tpu.feature / .dist
+  GraphSageSampler, MixedGraphSageSampler  -> quiver_tpu.sampler / .mixed
+  SampleJob                                -> quiver_tpu.mixed
+  CSRTopo                                  -> quiver_tpu.utils.topology
+  p2pCliqueTopo / init_p2p                 -> quiver_tpu.utils.mesh (MeshTopo)
+  NcclComm / getNcclId                     -> quiver_tpu.dist.comm (TpuComm)
+  quiver_partition_feature, load_...       -> quiver_tpu.partition
+  generate_neighbour_num                   -> quiver_tpu.neighbour_num
+  RequestBatcher/HybridSampler/InferenceServer -> quiver_tpu.serving
+"""
+
+from .utils.topology import CSRTopo, coo_to_csr, parse_size, reindex_feature
+from .utils.mesh import MeshTopo, make_mesh
+from .sampler import GraphSageSampler, SampledBatch, LayerBlock
+from .mixed import MixedGraphSageSampler, SampleJob
+from .feature import Feature, DeviceConfig
+from .dist.feature import DistFeature, PartitionInfo
+from .dist.comm import TpuComm
+from .partition import (
+    partition_without_replication,
+    quiver_partition_feature,
+    load_quiver_feature_partition,
+)
+from .neighbour_num import generate_neighbour_num
+from .serving import (
+    RequestBatcher,
+    HybridSampler,
+    InferenceServer,
+    InferenceServer_Debug,
+)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "CSRTopo", "coo_to_csr", "parse_size", "reindex_feature",
+    "MeshTopo", "make_mesh",
+    "GraphSageSampler", "SampledBatch", "LayerBlock",
+    "MixedGraphSageSampler", "SampleJob",
+    "Feature", "DeviceConfig",
+    "DistFeature", "PartitionInfo", "TpuComm",
+    "partition_without_replication", "quiver_partition_feature",
+    "load_quiver_feature_partition",
+    "generate_neighbour_num",
+    "RequestBatcher", "HybridSampler", "InferenceServer",
+    "InferenceServer_Debug",
+]

@@ -1,0 +1,2 @@
+from .comm import TpuComm, getNcclId
+from .feature import DistFeature, PartitionInfo

@@ -1,0 +1,227 @@
+"""Cross-host partitioned feature store — TPU-native ``DistFeature``.
+
+Reference parity: ``PartitionInfo`` (``feature.py:461-526``) and
+``DistFeature`` (``feature.py:529-567``) + the NCCL ``exchange``
+(``comm.py:127-182``).
+
+TPU-first redesign: the whole request/response dance — dispatch ids by
+owner, send id lists, remote gather, send features back, scatter merge — is
+ONE jitted ``shard_map`` body with two ``all_to_all``s.  Ragged per-host
+request counts become fixed-capacity buckets with validity masks (the
+static-shape discipline); XLA overlaps the collective with the local gather.
+
+Layout: the partitioned feature lives as a single ``jax.Array`` of shape
+``[n_parts * max_local, D]`` sharded over the mesh axis, so "host p's
+shard" is rows ``[p*max_local, (p+1)*max_local)`` — device-local on p.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:
+    from jax import shard_map  # jax >= 0.8
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map  # type: ignore
+
+__all__ = ["PartitionInfo", "DistFeature"]
+
+
+class PartitionInfo:
+    """Node -> (owner, local slot) maps (parity: ``feature.py:461-526``).
+
+    Args:
+      device: this rank (kept for parity).
+      host: host index of this rank.
+      hosts: number of hosts (partitions).
+      global2host: ``[N]`` int array, owner host per node.
+      replicate: optional id array of nodes replicated on every host.
+    """
+
+    def __init__(self, device=0, host: int = 0, hosts: int = 1,
+                 global2host=None, replicate=None):
+        self.device = device
+        self.host = host
+        self.hosts = hosts
+        self.global2host = np.asarray(global2host, dtype=np.int32)
+        n = self.global2host.shape[0]
+        self.replicate_mask = np.zeros(n, dtype=bool)
+        if replicate is not None:
+            self.replicate_mask[np.asarray(replicate)] = True
+        # local slot of each node on its owner (replicated nodes get a slot
+        # on EVERY host: they're appended after the owned block).
+        owner = self.global2host.copy()
+        self.global2local = np.zeros(n, dtype=np.int32)
+        owned_counts = np.zeros(hosts, dtype=np.int64)
+        order = np.argsort(owner, kind="stable")
+        for h in range(hosts):
+            ids = order[owner[order] == h]
+            ids = ids[~self.replicate_mask[ids]]
+            self.global2local[ids] = np.arange(len(ids), dtype=np.int32)
+            owned_counts[h] = len(ids)
+        self.owned_counts = owned_counts
+        rep_ids = np.nonzero(self.replicate_mask)[0]
+        self.rep_ids = rep_ids
+        # replicated nodes: slot = owned_count(host) + rank in rep list —
+        # assigned at build time per host (see DistFeature.build_shards).
+        self.max_local = int(owned_counts.max() + len(rep_ids))
+
+    def dispatch(self, ids: np.ndarray):
+        """Parity helper (``feature.py:510-526``): bucket ids per host.
+
+        Returns (list of id arrays per host, list of position arrays).
+        Served from DistFeature's jitted path in production; kept for tests
+        and API compat.
+        """
+        ids = np.asarray(ids)
+        owner = np.where(self.replicate_mask[ids], self.host,
+                         self.global2host[ids])
+        out_ids, out_pos = [], []
+        for h in range(self.hosts):
+            m = owner == h
+            out_ids.append(ids[m])
+            out_pos.append(np.nonzero(m)[0])
+        return out_ids, out_pos
+
+
+class DistFeature:
+    """Sharded feature with all-to-all remote lookup.
+
+    Build with :meth:`from_global_feature` (single-controller: the full
+    feature is available and gets laid out into shards), then index with
+    ``dist_feature[ids]`` where ``ids`` is ``[n_hosts, B]`` (one query batch
+    per host shard) or ``[B]`` (this host's batch, parity mode).
+    """
+
+    def __init__(self, mesh: Mesh, info: PartitionInfo, axis: str = "data",
+                 request_cap: Optional[int] = None):
+        self.mesh = mesh
+        self.info = info
+        self.axis = axis
+        self.n = int(mesh.shape[axis])
+        assert self.n == info.hosts, (self.n, info.hosts)
+        self.request_cap = request_cap
+        self.shards = None       # [n*max_local, D] sharded
+        self.g2l = None          # [N] int32 device (local slot incl. replicas)
+        self.g2h = None          # [N] int32 device
+        self._fn = {}
+
+    @classmethod
+    def from_global_feature(cls, feature: np.ndarray, mesh: Mesh,
+                            info: PartitionInfo, axis: str = "data",
+                            request_cap: Optional[int] = None):
+        self = cls(mesh, info, axis, request_cap)
+        n, d = feature.shape
+        m = info.max_local
+        shards = np.zeros((info.hosts, m, d), dtype=feature.dtype)
+        g2l = info.global2local.copy()
+        for h in range(info.hosts):
+            owned = np.nonzero(
+                (info.global2host == h) & ~info.replicate_mask
+            )[0]
+            shards[h, g2l[owned]] = feature[owned]
+            base = info.owned_counts[h]
+            if len(info.rep_ids):
+                shards[h, base: base + len(info.rep_ids)] = (
+                    feature[info.rep_ids]
+                )
+        # replicated nodes resolve to the local copy on every host; their
+        # slot depends on the host's owned_count, so store per-host offset
+        # and fold at lookup (slot = owned_count[host] + rep_rank).
+        rep_rank = np.zeros(n, dtype=np.int32)
+        rep_rank[info.rep_ids] = np.arange(len(info.rep_ids), dtype=np.int32)
+        self._rep_rank = rep_rank
+        sharding = NamedSharding(mesh, P(axis, None, None))
+        self.shards = jax.device_put(shards, sharding)
+        self.g2l = jnp.asarray(g2l)
+        self.g2h = jnp.asarray(info.global2host)
+        self.rep_mask = jnp.asarray(info.replicate_mask)
+        self.rep_rank = jnp.asarray(rep_rank)
+        self.owned_counts = jnp.asarray(info.owned_counts.astype(np.int32))
+        return self
+
+    # ------------------------------------------------------------------
+    def _build(self, B: int, cap: int):
+        n, axis = self.n, self.axis
+        g2l, g2h = self.g2l, self.g2h
+        rep_mask, rep_rank = self.rep_mask, self.rep_rank
+        owned_counts = self.owned_counts
+
+        def body(shard, ids, valid):
+            # shard: [1, m, D]; ids, valid: [1, B] — this rank's query batch.
+            shard = shard[0]
+            ids, valid = ids[0], valid[0]
+            me = jax.lax.axis_index(axis)
+            local_rep = rep_mask[ids]
+            owner = jnp.where(local_rep, me, g2h[ids])
+            owner = jnp.where(valid, owner, n)  # invalid -> nowhere
+            # rank of each query within its destination bucket
+            onehot = (owner[:, None] == jnp.arange(n)[None, :])
+            rank_in = jnp.cumsum(onehot, axis=0) - 1
+            slot = jnp.sum(jnp.where(onehot, rank_in, 0), axis=1)
+            overflow = slot >= cap
+            dest = jnp.where(valid & ~overflow, owner * cap + slot, n * cap)
+            # requests: [n*cap] node ids (+1 shift, 0 = empty)
+            reqs = jnp.zeros((n * cap,), jnp.int32).at[dest].add(
+                (ids + 1).astype(jnp.int32), mode="drop"
+            )
+            reqs = reqs.reshape(n, cap)
+            # ---- phase 1: ship request ids to owners
+            recv = jax.lax.all_to_all(reqs, axis, split_axis=0,
+                                      concat_axis=0, tiled=True)
+            # recv: [n, cap] requests FROM each source rank, for me.
+            rids = recv.reshape(-1) - 1
+            rvalid = rids >= 0
+            rid_safe = jnp.where(rvalid, rids, 0)
+            lslot = jnp.where(
+                rep_mask[rid_safe],
+                owned_counts[me] + rep_rank[rid_safe],
+                g2l[rid_safe],
+            )
+            feats = jnp.take(shard, lslot, axis=0)
+            feats = jnp.where(rvalid[:, None], feats, 0)
+            feats = feats.reshape(n, cap, -1)
+            # ---- phase 2: ship features back to requesters
+            back = jax.lax.all_to_all(feats, axis, split_axis=0,
+                                      concat_axis=0, tiled=True)
+            flat = back.reshape(n * cap, -1)
+            gathered = jnp.take(flat, jnp.clip(dest, 0, n * cap - 1),
+                                axis=0)
+            out = jnp.where((valid & ~overflow)[:, None], gathered, 0)
+            return out[None]
+
+        f = shard_map(
+            body, mesh=self.mesh,
+            in_specs=(P(axis, None, None), P(axis, None), P(axis, None)),
+            out_specs=P(axis, None, None),
+        )
+        return jax.jit(f)
+
+    def lookup(self, ids, valid=None):
+        """``ids``: [n_hosts, B] int32 (one batch per host).  Returns
+        [n_hosts, B, D] with each host's features resolved."""
+        ids = jnp.asarray(ids, jnp.int32)
+        nh, B = ids.shape
+        if valid is None:
+            valid = jnp.ones((nh, B), bool)
+        cap = self.request_cap or B
+        key = (B, cap)
+        if key not in self._fn:
+            self._fn[key] = self._build(B, cap)
+        sharding = NamedSharding(self.mesh, P(self.axis, None))
+        ids = jax.device_put(ids, sharding)
+        valid = jax.device_put(valid, sharding)
+        return self._fn[key](self.shards, ids, valid)
+
+    def __getitem__(self, ids):
+        ids = np.asarray(ids)
+        if ids.ndim == 1:  # parity mode: same batch replicated per host
+            out = self.lookup(np.tile(ids[None], (self.n, 1)))
+            return out[self.info.host]
+        return self.lookup(ids)

@@ -1,0 +1,168 @@
+"""Adaptive TPU+CPU mixed sampling — TPU-native ``MixedGraphSageSampler``.
+
+Reference parity: ``srcs/python/quiver/pyg/sage_sampler.py:180-376``
+(``SampleJob`` abstract task list, worker process pool, per-epoch feedback
+``decide_task_num`` re-splitting the task budget by measured device vs CPU
+sample time).
+
+TPU-first redesign: CPU sampling runs in **threads**, not processes — the
+native sampler (``cpp/csrc/quiver_cpu.cpp``) holds no GIL during its call,
+so a thread pool gets full parallelism without pickling graphs across
+process boundaries (the whole reason the reference needed its IPC
+machinery).  Device sampling stays on the main thread feeding the TPU; the
+feedback loop is the same time-ratio heuristic as the reference.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Generic, Iterator, List, Optional, Sequence, TypeVar
+
+import numpy as np
+
+from .sampler import GraphSageSampler, SampledBatch
+from .utils.topology import CSRTopo
+
+T_co = TypeVar("T_co", covariant=True)
+
+__all__ = ["SampleJob", "MixedGraphSageSampler", "RangeSampleJob"]
+
+
+class SampleJob(Generic[T_co]):
+    """Abstract indexable task list (parity: sage_sampler.py:180-195)."""
+
+    def __getitem__(self, index) -> T_co:
+        raise NotImplementedError
+
+    def __len__(self) -> int:
+        raise NotImplementedError
+
+    def shuffle(self) -> None:
+        raise NotImplementedError
+
+
+class RangeSampleJob(SampleJob):
+    """Seed ids chunked into fixed-size batches."""
+
+    def __init__(self, ids: np.ndarray, batch_size: int, seed: int = 0):
+        self.ids = np.asarray(ids)
+        self.batch_size = batch_size
+        self._rng = np.random.default_rng(seed)
+
+    def __len__(self):
+        return (len(self.ids) + self.batch_size - 1) // self.batch_size
+
+    def __getitem__(self, i):
+        return self.ids[i * self.batch_size: (i + 1) * self.batch_size]
+
+    def shuffle(self):
+        self._rng.shuffle(self.ids)
+
+
+class MixedGraphSageSampler:
+    """Iterate a :class:`SampleJob`, splitting work TPU/CPU adaptively.
+
+    Modes (parity with the reference's four): ``"TPU_CPU_MIXED"``
+    (default; aliases ``UVA_CPU_MIXED``/``GPU_CPU_MIXED`` accepted),
+    ``"TPU_ONLY"`` (aliases ``UVA_ONLY``/``GPU_ONLY``), ``"CPU_ONLY"``.
+
+    Iterating yields ``(SampledBatch, source)`` per task, where source is
+    ``"tpu"`` or ``"cpu"``.
+    """
+
+    _ALIASES = {
+        "UVA_CPU_MIXED": "TPU_CPU_MIXED", "GPU_CPU_MIXED": "TPU_CPU_MIXED",
+        "UVA_ONLY": "TPU_ONLY", "GPU_ONLY": "TPU_ONLY",
+    }
+
+    def __init__(self, csr_topo: CSRTopo, sizes: Sequence[int],
+                 sample_job: SampleJob, device=None,
+                 mode: str = "TPU_CPU_MIXED", num_workers: int = 4,
+                 frontier_caps=None):
+        mode = self._ALIASES.get(mode, mode)
+        assert mode in ("TPU_CPU_MIXED", "TPU_ONLY", "CPU_ONLY"), mode
+        self.mode = mode
+        self.job = sample_job
+        self.num_workers = num_workers
+        self.tpu_sampler = (
+            GraphSageSampler(csr_topo, sizes, device=device, mode="TPU",
+                             frontier_caps=frontier_caps)
+            if mode != "CPU_ONLY" else None
+        )
+        self.cpu_sampler = (
+            GraphSageSampler(csr_topo, sizes, mode="CPU")
+            if mode != "TPU_ONLY" else None
+        )
+        # feedback state (parity: decide_task_num, sage_sampler.py:272-288)
+        self.avg_tpu_time = None
+        self.avg_cpu_time = None
+
+    def _decide_cpu_share(self, n_tasks: int) -> int:
+        if self.mode == "CPU_ONLY":
+            return n_tasks
+        if self.mode == "TPU_ONLY" or self.avg_tpu_time is None:
+            return 0 if self.mode == "TPU_ONLY" else min(
+                self.num_workers, n_tasks // 4
+            )
+        # steady state: give CPU workers the share that equalizes finish time
+        tpu_rate = 1.0 / max(self.avg_tpu_time, 1e-9)
+        cpu_rate = self.num_workers / max(self.avg_cpu_time, 1e-9)
+        share = n_tasks * cpu_rate / (tpu_rate + cpu_rate)
+        return int(min(share, n_tasks))
+
+    def __iter__(self) -> Iterator:
+        self.job.shuffle()
+        n = len(self.job)
+        cpu_share = self._decide_cpu_share(n)
+        cpu_tasks = list(range(n - cpu_share, n))
+        tpu_tasks = list(range(0, n - cpu_share))
+        results: "queue.Queue" = queue.Queue()
+        cpu_times: List[float] = []
+        stop = threading.Event()
+
+        def cpu_worker(task_ids):
+            for t in task_ids:
+                if stop.is_set():
+                    return
+                t0 = time.perf_counter()
+                batch = self.cpu_sampler.sample(self.job[t])
+                cpu_times.append(time.perf_counter() - t0)
+                results.put((batch, "cpu"))
+
+        threads = []
+        if cpu_tasks and self.cpu_sampler is not None:
+            chunks = np.array_split(np.asarray(cpu_tasks), self.num_workers)
+            for c in chunks:
+                if len(c) == 0:
+                    continue
+                th = threading.Thread(target=cpu_worker, args=(c.tolist(),),
+                                      daemon=True)
+                th.start()
+                threads.append(th)
+
+        tpu_times: List[float] = []
+        produced = 0
+        try:
+            for t in tpu_tasks:
+                t0 = time.perf_counter()
+                batch = self.tpu_sampler.sample(self.job[t])
+                batch.n_id.block_until_ready()
+                tpu_times.append(time.perf_counter() - t0)
+                yield batch, "tpu"
+                produced += 1
+                while not results.empty():
+                    yield results.get_nowait()
+                    produced += 1
+            while produced < n:
+                yield results.get()
+                produced += 1
+        finally:
+            stop.set()
+            for th in threads:
+                th.join(timeout=5)
+        if tpu_times:
+            self.avg_tpu_time = float(np.mean(tpu_times))
+        if cpu_times:
+            self.avg_cpu_time = float(np.mean(cpu_times))

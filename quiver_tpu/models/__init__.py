@@ -1,0 +1,3 @@
+from .layers import SAGEConv, GATConv
+from .sage import GraphSAGE
+from .gat import GAT

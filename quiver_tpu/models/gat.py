@@ -1,0 +1,43 @@
+"""GAT model (Flax) over sampled dense blocks.
+
+Parity target: the GAT example of the reference
+(``/root/reference/examples/pyg/`` GAT variants) — multi-head attention
+layers with ELU, final layer single-head mean.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import flax.linen as nn
+import jax
+
+from .layers import GATConv
+from ..sampler import LayerBlock
+
+__all__ = ["GAT"]
+
+
+class GAT(nn.Module):
+    hidden: int
+    out_dim: int
+    num_layers: int = 2
+    heads: int = 4
+    dropout: float = 0.5
+
+    @nn.compact
+    def __call__(self, x: jax.Array, blocks: Tuple[LayerBlock, ...],
+                 train: bool = False) -> jax.Array:
+        assert len(blocks) == self.num_layers
+        for i, blk in enumerate(blocks):
+            last = i == self.num_layers - 1
+            x = GATConv(
+                self.out_dim if last else self.hidden,
+                heads=1 if last else self.heads,
+                concat=not last,
+                name=f"gat{i}",
+            )(x, blk)
+            if not last:
+                x = nn.elu(x)
+                x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        return x

@@ -1,0 +1,41 @@
+"""GraphSAGE model (Flax) over sampled dense blocks.
+
+Parity target: the ``SAGE`` module of
+``/root/reference/examples/pyg/ogbn_products_sage_quiver.py:31-70`` (3-layer
+SAGEConv with ReLU + dropout between layers) and its quality bar (ogbn-
+products test acc ≈ 0.787 per that file's header).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from .layers import SAGEConv
+from ..sampler import LayerBlock
+
+__all__ = ["GraphSAGE"]
+
+
+class GraphSAGE(nn.Module):
+    hidden: int
+    out_dim: int
+    num_layers: int = 3
+    dropout: float = 0.5
+
+    @nn.compact
+    def __call__(self, x: jax.Array, blocks: Tuple[LayerBlock, ...],
+                 train: bool = False) -> jax.Array:
+        assert len(blocks) == self.num_layers, (
+            f"{len(blocks)} blocks for {self.num_layers} layers"
+        )
+        for i, blk in enumerate(blocks):
+            dim = self.out_dim if i == self.num_layers - 1 else self.hidden
+            x = SAGEConv(dim, name=f"conv{i}")(x, blk)
+            if i != self.num_layers - 1:
+                x = nn.relu(x)
+                x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        return x

@@ -1,0 +1,3 @@
+from .sample import sample_neighbors, SampleOut, to_ragged
+from .reindex import reindex, ReindexOut
+from .prob import cal_neighbor_prob, sample_prob

@@ -1,0 +1,1 @@
+"""Pallas TPU kernels (hot-path variants of the XLA ops)."""

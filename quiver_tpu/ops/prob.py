@@ -1,0 +1,50 @@
+"""Feature-access probability recurrence.
+
+Reference parity: ``cal_next`` kernel (``cuda_random.cu.hpp:72-104``),
+exposed as ``cal_neighbor_prob`` (``quiver_sample.cu:100-111``) and driven by
+``GraphSageSampler.sample_prob`` (``sage_sampler.py:149-157``).  The metric:
+expected number of times each node enters a sampled batch, layer by layer —
+it drives the hot-cache split and the cross-host partitioner.
+
+The CUDA kernel is a scatter-add over edges: node ``u`` with probability
+``p[u]`` contributes ``p[u] * min(1, k/deg(u))`` to each of its neighbors.
+On TPU that is one ``segment_sum`` over the edge array — a memory-bound op
+XLA handles well; no custom kernel needed.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["cal_neighbor_prob", "sample_prob"]
+
+
+@jax.jit
+def cal_neighbor_prob(indptr: jax.Array, indices: jax.Array,
+                      last_prob: jax.Array, k: int) -> jax.Array:
+    """One layer of the access-probability recurrence."""
+    n = indptr.shape[0] - 1
+    deg = (indptr[1:] - indptr[:-1]).astype(jnp.float32)
+    w = last_prob * jnp.minimum(1.0, k / jnp.maximum(deg, 1.0))
+    # expand per-edge source weights: edge e belongs to row r(e)
+    row_of_edge = jnp.searchsorted(
+        indptr, jnp.arange(indices.shape[0], dtype=indptr.dtype), side="right"
+    ) - 1
+    contrib = w[row_of_edge]
+    return jax.ops.segment_sum(contrib, indices, num_segments=n)
+
+
+def sample_prob(indptr, indices, train_idx, total_node_count: int,
+                sizes: Sequence[int]) -> jax.Array:
+    """Multi-layer probability: parity with ``sample_prob``.
+
+    Returns the last layer's accumulated probability vector (float32 [N]).
+    """
+    last = jnp.zeros((total_node_count,), jnp.float32).at[train_idx].set(1.0)
+    for k in sizes:
+        last = cal_neighbor_prob(indptr, indices, last, k)
+    return last

@@ -1,0 +1,108 @@
+"""Frontier dedup + relabel — the TPU replacement for the CUDA ordered hash
+table (``srcs/cpp/include/quiver/reindex.cu.hpp:21-225`` and
+``TorchQuiver::reindex_single``, ``quiver_sample.cu:305-357``).
+
+Contract parity: given seeds and their sampled neighbors, produce
+``n_id`` (unique frontier, seeds first — ``n_id[:B] == seeds``) and the
+neighbor lists relabeled to local positions in ``n_id``.
+
+TPU-first redesign: linear-probing hash tables with atomicCAS don't map to
+the VPU.  Instead we sort once and use ``searchsorted``:
+  1. membership of each neighbor in ``seeds`` via binary search,
+  2. ``sort -> adjacent-unique -> compacting scatter`` for the non-seed
+     remainder (first-occurrence order is NOT preserved for non-seeds — they
+     come out id-sorted, which is a free locality win for the feature
+     gather and is semantically irrelevant: the frontier is a set).
+Everything is static-shaped: the frontier is padded to ``B + B*k`` (or a
+user cap) with a valid-count scalar, the bucketing discipline that replaces
+Quiver's dynamic allocations.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["reindex", "ReindexOut"]
+
+_SENTINEL = jnp.int32(2**31 - 1)
+
+
+class ReindexOut(NamedTuple):
+    n_id: jax.Array        # [B + B*k] int32, padded with 0 beyond num_nodes
+    num_nodes: jax.Array   # scalar int32: valid prefix length of n_id
+    n_id_mask: jax.Array   # [B + B*k] bool validity
+    local_nbrs: jax.Array  # [B, k] int32 positions into n_id (0 where ~mask)
+    mask: jax.Array        # [B, k] bool (same as sample mask)
+
+
+@functools.partial(jax.jit, static_argnames=())
+def reindex(
+    seeds: jax.Array,
+    nbrs: jax.Array,
+    mask: jax.Array,
+    seed_mask: Optional[jax.Array] = None,
+) -> ReindexOut:
+    """Dedup (seeds ∪ nbrs) and relabel ``nbrs`` to local frontier ids.
+
+    Args:
+      seeds: ``[B]`` int32.  If ``seed_mask`` given, invalid seeds still
+        occupy their slot in ``n_id`` (so local ids stay aligned across
+        layers) but must not also appear as padded garbage — they're 0s.
+      nbrs: ``[B, k]`` int32 from :func:`sample_neighbors`.
+      mask: ``[B, k]`` bool.
+    """
+    seeds = seeds.astype(jnp.int32)
+    B = seeds.shape[0]
+    k = nbrs.shape[1]
+    flatn = nbrs.reshape(-1)
+    flatm = mask.reshape(-1)
+    if seed_mask is None:
+        seed_mask = jnp.ones((B,), dtype=bool)
+
+    # --- membership of neighbors in seeds (binary search over sorted seeds).
+    # Invalid seeds are pushed to the top of the sort key so they never match.
+    seed_key = jnp.where(seed_mask, seeds, _SENTINEL)
+    order = jnp.argsort(seed_key)
+    seeds_sorted = seed_key[order]
+    loc = jnp.searchsorted(seeds_sorted, flatn)
+    locc = jnp.clip(loc, 0, B - 1)
+    in_seeds = (seeds_sorted[locc] == flatn) & flatm
+    seed_local = order[locc].astype(jnp.int32)
+
+    # --- unique of the non-seed remainder.
+    rest = jnp.where(flatm & ~in_seeds, flatn, _SENTINEL)
+    rest_sorted = jnp.sort(rest)
+    is_first = jnp.concatenate(
+        [jnp.ones(1, bool), rest_sorted[1:] != rest_sorted[:-1]]
+    ) & (rest_sorted != _SENTINEL)
+    rank = jnp.cumsum(is_first) - 1  # position among uniques
+    num_rest = is_first.sum().astype(jnp.int32)
+    uniq = jnp.full((B * k,), _SENTINEL, dtype=jnp.int32)
+    uniq = uniq.at[jnp.where(is_first, rank, B * k)].set(
+        rest_sorted, mode="drop"
+    )
+
+    # --- local ids.
+    rest_local = B + jnp.searchsorted(uniq, flatn).astype(jnp.int32)
+    local = jnp.where(in_seeds, seed_local, rest_local)
+    local = jnp.where(flatm, local, 0).reshape(B, k).astype(jnp.int32)
+
+    # --- assemble padded frontier, seeds first.
+    n_id = jnp.concatenate([jnp.where(seed_mask, seeds, 0),
+                            jnp.where(uniq == _SENTINEL, 0, uniq)])
+    pos = jnp.arange(B + B * k, dtype=jnp.int32)
+    n_id_mask = jnp.where(
+        pos < B, seed_mask[jnp.clip(pos, 0, B - 1)], (pos - B) < num_rest
+    )
+    num_nodes = n_id_mask.sum().astype(jnp.int32)
+    return ReindexOut(
+        n_id=n_id,
+        num_nodes=num_nodes,
+        n_id_mask=n_id_mask,
+        local_nbrs=local,
+        mask=mask,
+    )

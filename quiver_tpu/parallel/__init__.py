@@ -1,0 +1,1 @@
+from .train import TrainState, make_train_step, shard_batch, replicate

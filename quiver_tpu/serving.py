@@ -1,0 +1,297 @@
+"""GNN serving pipeline — TPU-native batcher / hybrid sampler / server.
+
+Reference parity: ``srcs/python/quiver/serving.py`` —
+``RequestBatcher`` (:10-98, workload-aware ``auto_despatch`` routing by
+summed per-node ``neighbour_num`` vs a threshold), ``HybridSampler``
+(:101-147, CPU sampler workers), ``InferenceServer`` / ``_Debug``
+(:150-360, sample→feature→model loops + tp99 accounting).
+
+TPU-first redesign: the reference shards the pipeline over *processes* with
+``mp.Manager().Queue``s because CUDA contexts and the GIL force it to.  Here
+the single-controller model inverts that: stages are **threads** sharing one
+process (the native CPU sampler and XLA release the GIL), queues are
+``queue.Queue``, and the device stage uses **bucketed batch shapes** (pad to
+the next power of two) so every request size hits a cached jit executable —
+the TPU answer to CUDA's any-shape kernel launches.  Routing keeps the same
+mechanism: requests whose expected expansion is small run on the CPU
+sampler (low latency, no device round-trip), big ones batch onto the TPU.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = [
+    "RequestBatcher", "HybridSampler", "InferenceServer",
+    "InferenceServer_Debug", "ServingRequest",
+]
+
+_STOP = object()
+
+
+@dataclass
+class ServingRequest:
+    ids: np.ndarray
+    client: int
+    seq: int
+    t_enqueue: float = field(default_factory=time.perf_counter)
+
+
+def _next_bucket(n: int, buckets: Sequence[int]) -> int:
+    for b in buckets:
+        if n <= b:
+            return b
+    return buckets[-1]
+
+
+class RequestBatcher:
+    """Route per-client request streams to the CPU or TPU lane.
+
+    Args:
+      stream_queues: input queues, one per client.
+      neighbour_num: ``[N]`` expected expansion per node (from
+        :func:`quiver_tpu.generate_neighbour_num`).
+      threshold: requests with ``sum(neighbour_num[ids]) <= threshold`` go
+        to the CPU lane (mode="Auto"), mirroring ``auto_despatch``
+        (serving.py:72-95).
+      mode: "Auto" | "CPU" | "Device" | "Preparation" (duplicate to both,
+        parity serving.py:60-70).
+    """
+
+    def __init__(self, stream_queues: List["queue.Queue"],
+                 neighbour_num: Optional[np.ndarray] = None,
+                 threshold: float = 0.0, mode: str = "Auto"):
+        assert mode in ("Auto", "CPU", "Device", "Preparation")
+        self.stream_queues = stream_queues
+        self.neighbour_num = neighbour_num
+        self.threshold = threshold
+        self.mode = mode
+        self.cpu_batched_queue: "queue.Queue" = queue.Queue()
+        self.device_batched_queue: "queue.Queue" = queue.Queue()
+        self._threads: List[threading.Thread] = []
+
+    def _route(self, req: ServingRequest):
+        if self.mode == "CPU":
+            self.cpu_batched_queue.put(req)
+        elif self.mode == "Device":
+            self.device_batched_queue.put(req)
+        elif self.mode == "Preparation":
+            self.cpu_batched_queue.put(req)
+            self.device_batched_queue.put(req)
+        else:
+            load = (
+                float(self.neighbour_num[req.ids].sum())
+                if self.neighbour_num is not None else float("inf")
+            )
+            if load <= self.threshold:
+                self.cpu_batched_queue.put(req)
+            else:
+                self.device_batched_queue.put(req)
+
+    def _worker(self, q: "queue.Queue"):
+        while True:
+            item = q.get()
+            if item is _STOP:
+                break
+            if not isinstance(item, ServingRequest):
+                item = ServingRequest(ids=np.asarray(item), client=-1, seq=-1)
+            self._route(item)
+
+    def start(self):
+        for q in self.stream_queues:
+            t = threading.Thread(target=self._worker, args=(q,), daemon=True)
+            t.start()
+            self._threads.append(t)
+        return self
+
+    def stop(self):
+        for q in self.stream_queues:
+            q.put(_STOP)
+        for t in self._threads:
+            t.join(timeout=5)
+        self.cpu_batched_queue.put(_STOP)
+        self.device_batched_queue.put(_STOP)
+
+
+class HybridSampler:
+    """CPU-lane sampler workers (parity: serving.py:101-147).
+
+    Pulls requests from the batcher's CPU queue, samples with the native
+    host sampler, pushes ``(request, SampledBatch, sample_time)`` to
+    ``sampled_queue``.
+    """
+
+    def __init__(self, cpu_sampler, cpu_batched_queue: "queue.Queue",
+                 num_workers: int = 2):
+        self.sampler = cpu_sampler
+        self.inq = cpu_batched_queue
+        self.sampled_queue: "queue.Queue" = queue.Queue()
+        self.num_workers = num_workers
+        self._threads: List[threading.Thread] = []
+
+    def _loop(self):
+        while True:
+            item = self.inq.get()
+            if item is _STOP:
+                self.inq.put(_STOP)  # let siblings see it too
+                break
+            t0 = time.perf_counter()
+            batch = self.sampler.sample(item.ids)
+            self.sampled_queue.put((item, batch, time.perf_counter() - t0))
+
+    def start(self):
+        for _ in range(self.num_workers):
+            t = threading.Thread(target=self._loop, daemon=True)
+            t.start()
+            self._threads.append(t)
+        return self
+
+    def stop(self):
+        self.inq.put(_STOP)
+        for t in self._threads:
+            t.join(timeout=5)
+        self.sampled_queue.put(_STOP)
+
+
+class InferenceServer:
+    """Device stage: sample (TPU lane) → gather → model → result queue.
+
+    Parity: serving.py:150-296.  One device thread drives the TPU with
+    bucketed shapes; CPU-lane pre-sampled batches share the same forward.
+    ``apply_fn(params, x, blocks)`` is the jitted model forward.
+    """
+
+    BUCKETS = (8, 16, 32, 64, 128, 256, 512, 1024, 2048)
+
+    def __init__(self, tpu_sampler, feature, apply_fn: Callable, params,
+                 device_batched_queue: "queue.Queue",
+                 cpu_sampled_queue: Optional["queue.Queue"] = None,
+                 result_queue: Optional["queue.Queue"] = None):
+        self.sampler = tpu_sampler
+        self.feature = feature
+        self.apply_fn = apply_fn
+        self.params = params
+        self.device_q = device_batched_queue
+        self.cpu_q = cpu_sampled_queue
+        self.result_queue = result_queue or queue.Queue()
+        self._threads: List[threading.Thread] = []
+        self._stopped = threading.Event()
+
+    # -- core per-request paths ---------------------------------------
+    def _pad_ids(self, ids: np.ndarray) -> np.ndarray:
+        b = _next_bucket(len(ids), self.BUCKETS)
+        if len(ids) == b:
+            return ids
+        return np.concatenate([ids, np.full(b - len(ids), ids[0] if len(ids)
+                                            else 0, dtype=ids.dtype)])
+
+    def _infer_device(self, req: ServingRequest):
+        ids = np.asarray(req.ids)
+        padded = self._pad_ids(ids)
+        batch = self.sampler.sample(padded)
+        x = self.feature[np.asarray(batch.n_id)]
+        out = self.apply_fn(self.params, x, batch.layers)
+        return np.asarray(out)[: len(ids)]
+
+    def _infer_presampled(self, req: ServingRequest, batch):
+        x = self.feature[np.asarray(batch.n_id)]
+        out = self.apply_fn(self.params, x, batch.layers)
+        return np.asarray(out)[: len(req.ids)]
+
+    # -- loops ---------------------------------------------------------
+    def _device_loop(self):
+        while not self._stopped.is_set():
+            item = self.device_q.get()
+            if item is _STOP:
+                break
+            out = self._infer_device(item)
+            self.result_queue.put((item, out))
+
+    def _cpu_loop(self):
+        while not self._stopped.is_set():
+            item = self.cpu_q.get()
+            if item is _STOP:
+                break
+            req, batch, _ = item
+            out = self._infer_presampled(req, batch)
+            self.result_queue.put((req, out))
+
+    def start(self):
+        t = threading.Thread(target=self._device_loop, daemon=True)
+        t.start()
+        self._threads.append(t)
+        if self.cpu_q is not None:
+            t2 = threading.Thread(target=self._cpu_loop, daemon=True)
+            t2.start()
+            self._threads.append(t2)
+        return self
+
+    def stop(self):
+        self._stopped.set()
+        self.device_q.put(_STOP)
+        if self.cpu_q is not None:
+            self.cpu_q.put(_STOP)
+        for t in self._threads:
+            t.join(timeout=10)
+
+
+class InferenceServer_Debug(InferenceServer):
+    """Latency-instrumented server (parity: serving.py:298-360).
+
+    Records per-request end-to-end latency; ``stats()`` returns
+    avg / p50 / p99 latency and throughput, the reference's tp99 harness.
+    """
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.latencies: List[float] = []
+        self._t_first = None
+        self._t_last = None
+        self._count = 0
+        self._lock = threading.Lock()
+
+    def _record(self, req: ServingRequest):
+        now = time.perf_counter()
+        with self._lock:
+            self.latencies.append(now - req.t_enqueue)
+            self._t_first = self._t_first or req.t_enqueue
+            self._t_last = now
+            self._count += 1
+
+    def _device_loop(self):
+        while not self._stopped.is_set():
+            item = self.device_q.get()
+            if item is _STOP:
+                break
+            out = self._infer_device(item)
+            self._record(item)
+            self.result_queue.put((item, out))
+
+    def _cpu_loop(self):
+        while not self._stopped.is_set():
+            item = self.cpu_q.get()
+            if item is _STOP:
+                break
+            req, batch, _ = item
+            out = self._infer_presampled(req, batch)
+            self._record(req)
+            self.result_queue.put((req, out))
+
+    def stats(self) -> dict:
+        lat = np.asarray(sorted(self.latencies))
+        if len(lat) == 0:
+            return dict(count=0)
+        span = max((self._t_last or 0) - (self._t_first or 0), 1e-9)
+        return dict(
+            count=int(self._count),
+            avg_latency_ms=float(lat.mean() * 1e3),
+            p50_latency_ms=float(np.percentile(lat, 50) * 1e3),
+            p99_latency_ms=float(np.percentile(lat, 99) * 1e3),
+            throughput_rps=float(self._count / span),
+        )

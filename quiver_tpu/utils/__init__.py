@@ -1,0 +1,2 @@
+from .topology import CSRTopo, coo_to_csr, parse_size, reindex_feature, reindex_by_config
+from .mesh import MeshTopo, make_mesh, init_p2p

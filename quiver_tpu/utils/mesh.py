@@ -1,0 +1,79 @@
+"""Device-mesh topology helpers.
+
+Reference parity: ``p2pCliqueTopo`` / ``init_p2p``
+(``srcs/python/quiver/utils.py:7-106``, ``quiver_feature.cu:378-428``).
+
+The reference probes pairwise ``cudaDeviceCanAccessPeer`` and colors the
+access matrix into NVLink cliques.  On TPU the equivalent structure is free:
+every chip in a slice is connected over ICI, and host boundaries (DCN) are
+visible via ``device.process_index``.  So the "clique" of a device is the
+set of devices on its ICI fabric — for feature sharding we treat each
+process's local devices as the fast clique and cross-process as the DCN
+tier, which is exactly how the reference splits NVLink vs NCCL tiers.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["MeshTopo", "make_mesh", "init_p2p"]
+
+
+class MeshTopo:
+    """ICI/DCN topology view over the available jax devices."""
+
+    def __init__(self, devices: Optional[Sequence] = None):
+        import jax
+
+        self.devices = list(devices) if devices is not None else jax.devices()
+        cliques: Dict[int, List] = {}
+        for d in self.devices:
+            cliques.setdefault(d.process_index, []).append(d)
+        self._cliques = {i: ds for i, (_, ds) in
+                         enumerate(sorted(cliques.items()))}
+
+    @property
+    def info(self) -> str:
+        lines = []
+        for cid, ds in self._cliques.items():
+            lines.append(
+                f"Clique {cid} (ICI): {[str(d) for d in ds]}"
+            )
+        return "\n".join(lines)
+
+    def get_clique_id(self, device) -> int:
+        for cid, ds in self._cliques.items():
+            if device in ds:
+                return cid
+        raise KeyError(device)
+
+    def p2p_clique(self) -> Dict[int, List]:
+        return dict(self._cliques)
+
+    @property
+    def p2p_clique_device_list(self):
+        return [ds for _, ds in sorted(self._cliques.items())]
+
+
+def make_mesh(axis_names: Sequence[str] = ("data",),
+              shape: Optional[Sequence[int]] = None,
+              devices: Optional[Sequence] = None):
+    """Build a ``jax.sharding.Mesh`` over the given (or all) devices.
+
+    ``shape`` defaults to all devices on the first axis.  Multi-axis shapes
+    are filled major-to-minor, matching ``mesh_utils`` conventions.
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    devs = np.array(devices if devices is not None else jax.devices())
+    if shape is None:
+        shape = [len(devs)] + [1] * (len(axis_names) - 1)
+    return Mesh(devs.reshape(tuple(shape)), tuple(axis_names))
+
+
+def init_p2p(device_list=None):
+    """No-op on TPU (ICI is always on); kept for API parity."""
+    return MeshTopo()

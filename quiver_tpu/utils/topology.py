@@ -1,0 +1,197 @@
+"""Graph topology containers for the TPU-native quiver rebuild.
+
+Reference parity: ``srcs/python/quiver/utils.py:119-225`` (``CSRTopo``),
+``utils.py:229-247`` (``reindex_by_config`` / ``reindex_feature``),
+``utils.py:259-280`` (``parse_size``).
+
+Design notes (TPU-first):
+  * The canonical storage is a pair of **numpy** arrays (``indptr``,
+    ``indices``) on host; device placement is explicit via
+    :meth:`CSRTopo.to_device`, which returns jax Arrays in HBM.  There is no
+    UVA / pinned-memory mode: the TPU analogue of "graph bigger than device
+    memory" is sharding the edge array across a mesh (see
+    ``quiver_tpu.dist``) or keeping the topology on host and sampling there
+    (CPU mode, ``quiver_tpu.cpp``).
+  * ``indices`` is int32 (node ids), ``indptr`` is int64 on host. For the
+    on-device path we require ``edge_count < 2**31`` per *shard* so indptr
+    fits int32 (XLA default); larger graphs must be sharded, which is also
+    what the bandwidth math wants.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+__all__ = [
+    "CSRTopo",
+    "coo_to_csr",
+    "parse_size",
+    "reindex_feature",
+    "reindex_by_config",
+    "UNITS",
+]
+
+
+def coo_to_csr(
+    src: np.ndarray, dst: np.ndarray, node_count: Optional[int] = None
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """COO edge list -> CSR (indptr, indices, eid), rows are ``src``.
+
+    Replaces the reference's scipy ``csr_matrix`` detour
+    (``utils.py:109-116``) and the GPU zip/sort path
+    (``quiver_sample.cu:463-497``) with a single stable counting sort.
+    Returns ``eid`` (the permutation of input edge positions) so edge
+    features can follow the reorder.
+    """
+    src = np.asarray(src)
+    dst = np.asarray(dst)
+    if node_count is None:
+        node_count = int(max(src.max(), dst.max())) + 1 if src.size else 0
+    counts = np.bincount(src, minlength=node_count).astype(np.int64)
+    indptr = np.zeros(node_count + 1, dtype=np.int64)
+    np.cumsum(counts, out=indptr[1:])
+    # Stable sort by src keeps each row's neighbors in input order.
+    eid = np.argsort(src, kind="stable").astype(np.int64)
+    indices = dst[eid].astype(np.int32)
+    return indptr, indices, eid
+
+
+class CSRTopo:
+    """Graph topology in CSR format (host-resident numpy).
+
+    ``CSRTopo(edge_index=...)`` or ``CSRTopo(indptr=..., indices=...)``,
+    mirroring the reference API (``utils.py:119-152``).  ``edge_index`` is a
+    ``[2, E]`` array-like of (src, dst).
+    """
+
+    def __init__(self, edge_index=None, indptr=None, indices=None, eid=None,
+                 node_count: Optional[int] = None):
+        if edge_index is not None:
+            edge_index = np.asarray(edge_index)
+            self.indptr_, self.indices_, self.eid_ = coo_to_csr(
+                edge_index[0], edge_index[1], node_count
+            )
+            if eid is not None:
+                self.eid_ = np.asarray(eid)[self.eid_]
+        elif indptr is not None and indices is not None:
+            self.indptr_ = np.asarray(indptr, dtype=np.int64)
+            self.indices_ = np.asarray(indices, dtype=np.int32)
+            self.eid_ = None if eid is None else np.asarray(eid)
+        else:
+            raise ValueError("need edge_index or (indptr, indices)")
+        self.feature_order_: Optional[np.ndarray] = None
+        self._device_arrays = None
+
+    @property
+    def indptr(self) -> np.ndarray:
+        return self.indptr_
+
+    @property
+    def indices(self) -> np.ndarray:
+        return self.indices_
+
+    @property
+    def eid(self):
+        return self.eid_
+
+    @property
+    def feature_order(self):
+        return self.feature_order_
+
+    @feature_order.setter
+    def feature_order(self, feature_order):
+        self.feature_order_ = (
+            None if feature_order is None else np.asarray(feature_order)
+        )
+
+    @property
+    def degree(self) -> np.ndarray:
+        return self.indptr[1:] - self.indptr[:-1]
+
+    @property
+    def node_count(self) -> int:
+        return int(self.indptr_.shape[0] - 1)
+
+    @property
+    def edge_count(self) -> int:
+        return int(self.indices_.shape[0])
+
+    def to_device(self, device=None):
+        """Place (indptr, indices) in device HBM as int32 jax Arrays.
+
+        Requires ``edge_count < 2**31``.  The result is cached on the object.
+        """
+        import jax
+        import jax.numpy as jnp
+
+        if self._device_arrays is None:
+            if self.edge_count >= 2**31:
+                raise ValueError(
+                    "edge_count >= 2^31: shard the graph (quiver_tpu.dist) "
+                    "instead of single-device placement"
+                )
+            indptr = jnp.asarray(self.indptr_, dtype=jnp.int32)
+            indices = jnp.asarray(self.indices_, dtype=jnp.int32)
+            if device is not None:
+                indptr = jax.device_put(indptr, device)
+                indices = jax.device_put(indices, device)
+            self._device_arrays = (indptr, indices)
+        return self._device_arrays
+
+    def share_memory_(self):  # torch-API compat: numpy arrays already share
+        return self
+
+    def __repr__(self):
+        return (
+            f"CSRTopo(nodes={self.node_count}, edges={self.edge_count})"
+        )
+
+
+def reindex_by_config(adj_csr: CSRTopo, graph_feature, gpu_portion: float,
+                      seed: int = 0):
+    """Degree-descending reorder with a shuffled hot prefix.
+
+    Parity with ``utils.py:229-242``: sorts nodes by degree (descending),
+    randomly permutes the top ``gpu_portion`` slice (so cache-resident rows
+    are load-balanced when later range-sharded), and returns the permuted
+    feature plus ``new_order`` mapping old id -> new row.
+    """
+    node_count = adj_csr.node_count
+    hot = int(node_count * gpu_portion)
+    degree = adj_csr.degree
+    prev_order = np.argsort(-degree, kind="stable")
+    rng = np.random.default_rng(seed)
+    prev_order[:hot] = prev_order[rng.permutation(hot)]
+    new_order = np.empty(node_count, dtype=np.int64)
+    new_order[prev_order] = np.arange(node_count, dtype=np.int64)
+    graph_feature = np.asarray(graph_feature)[prev_order]
+    return graph_feature, new_order
+
+
+def reindex_feature(graph: CSRTopo, feature, ratio: float, seed: int = 0):
+    assert isinstance(graph, CSRTopo), "Input graph should be CSRTopo object"
+    return reindex_by_config(graph, feature, ratio, seed=seed)
+
+
+UNITS = {
+    "KB": 2**10, "MB": 2**20, "GB": 2**30,
+    "K": 2**10, "M": 2**20, "G": 2**30,
+}
+
+
+def parse_size(sz) -> int:
+    """'200M' / '1.5GB' / int / float -> bytes (``utils.py:259-280``)."""
+    if isinstance(sz, int):
+        return sz
+    if isinstance(sz, float):
+        return int(sz)
+    if isinstance(sz, str):
+        s = sz.upper().strip()
+        for suf in sorted(UNITS, key=len, reverse=True):
+            if s.endswith(suf):
+                return int(float(s[: -len(suf)]) * UNITS[suf])
+        if s.isdigit():
+            return int(s)
+    raise ValueError(f"invalid size: {sz!r}")

@@ -1,0 +1,64 @@
+"""Test config: force CPU backend with 8 virtual devices BEFORE jax import.
+
+This gives every test a simulated 8-chip mesh (the multi-host coverage the
+reference never had — SURVEY.md §4's lesson), and keeps the suite runnable
+anywhere.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax
+
+# The axon site hook re-exports JAX_PLATFORMS=axon after env setup; the
+# config API takes final precedence, so pin the platform here too.
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)
+
+
+def make_random_csr(n_nodes=200, avg_deg=8, seed=0, power_law=False):
+    """Random graph fixture (parity: gen_random_graph,
+    tests/cpp/test_quiver.cu:17-85)."""
+    rng = np.random.default_rng(seed)
+    if power_law:
+        deg = np.minimum(
+            rng.zipf(1.6, n_nodes) + 1, n_nodes - 1
+        ).astype(np.int64)
+    else:
+        deg = rng.poisson(avg_deg, n_nodes).astype(np.int64)
+    src = np.repeat(np.arange(n_nodes), deg)
+    dst = rng.integers(0, n_nodes, size=src.shape[0])
+    # drop parallel edges so "k distinct positions" == "k distinct ids"
+    # in the property tests (samplers pick positions, as the reference does)
+    pairs = np.unique(np.stack([src, dst], axis=1), axis=0)
+    return pairs[:, 0], pairs[:, 1]
+
+
+@pytest.fixture
+def small_graph():
+    from quiver_tpu import CSRTopo
+
+    src, dst = make_random_csr(n_nodes=200, avg_deg=8, seed=1)
+    return CSRTopo(edge_index=np.stack([src, dst]))
+
+
+@pytest.fixture
+def power_graph():
+    from quiver_tpu import CSRTopo
+
+    src, dst = make_random_csr(n_nodes=500, avg_deg=8, seed=2,
+                               power_law=True)
+    return CSRTopo(edge_index=np.stack([src, dst]))

@@ -1,0 +1,98 @@
+"""Distributed feature exchange over an 8-device virtual mesh — the
+simulated multi-host coverage the reference lacked (SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from quiver_tpu.dist import DistFeature, PartitionInfo, TpuComm
+from quiver_tpu.utils.mesh import make_mesh
+
+
+NHOSTS = 8
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    assert jax.device_count() == NHOSTS
+    return make_mesh(("data",))
+
+
+def test_allreduce(mesh):
+    comm = TpuComm(mesh, "data")
+    x = np.arange(NHOSTS * 4, dtype=np.float32).reshape(NHOSTS, 4)
+    out = np.asarray(comm.allreduce(x))
+    np.testing.assert_allclose(out, x.sum(axis=0))
+
+
+def test_all_to_all(mesh):
+    comm = TpuComm(mesh, "data")
+    # x[i, j] = payload i sends to j
+    x = np.arange(NHOSTS * NHOSTS, dtype=np.int32).reshape(NHOSTS, NHOSTS, 1)
+    out = np.asarray(comm.all_to_all(x))
+    np.testing.assert_array_equal(out[:, :, 0], x[:, :, 0].T)
+
+
+def test_partition_info_dispatch():
+    n = 100
+    g2h = np.arange(n) % 4
+    info = PartitionInfo(host=1, hosts=4, global2host=g2h)
+    ids = np.array([0, 1, 2, 3, 4, 5, 6, 7])
+    out_ids, out_pos = info.dispatch(ids)
+    for h in range(4):
+        assert (g2h[out_ids[h]] == h).all()
+    got = np.concatenate(out_ids)
+    assert sorted(got.tolist()) == sorted(ids.tolist())
+
+
+def test_dist_feature_exchange(mesh, rng):
+    n, d = 256, 8
+    full = rng.normal(size=(n, d)).astype(np.float32)
+    g2h = rng.integers(0, NHOSTS, n).astype(np.int32)
+    info = PartitionInfo(host=0, hosts=NHOSTS, global2host=g2h)
+    df = DistFeature.from_global_feature(full, mesh, info)
+    B = 32
+    ids = rng.integers(0, n, (NHOSTS, B)).astype(np.int32)
+    out = np.asarray(df.lookup(ids))
+    for h in range(NHOSTS):
+        np.testing.assert_allclose(out[h], full[ids[h]], rtol=1e-6)
+
+
+def test_dist_feature_with_replication(mesh, rng):
+    n, d = 128, 4
+    full = rng.normal(size=(n, d)).astype(np.float32)
+    g2h = rng.integers(0, NHOSTS, n).astype(np.int32)
+    rep = np.array([0, 5, 17, 99])
+    info = PartitionInfo(host=0, hosts=NHOSTS, global2host=g2h,
+                         replicate=rep)
+    df = DistFeature.from_global_feature(full, mesh, info)
+    ids = np.tile(rep[None], (NHOSTS, 8)).astype(np.int32)
+    out = np.asarray(df.lookup(ids))
+    for h in range(NHOSTS):
+        np.testing.assert_allclose(out[h], full[ids[h]], rtol=1e-6)
+
+
+def test_dist_feature_skewed_load(mesh, rng):
+    """All requests target one owner — worst-case bucket pressure."""
+    n, d = 64, 4
+    full = rng.normal(size=(n, d)).astype(np.float32)
+    g2h = np.zeros(n, dtype=np.int32)  # everything owned by host 0
+    info = PartitionInfo(host=0, hosts=NHOSTS, global2host=g2h)
+    df = DistFeature.from_global_feature(full, mesh, info)
+    B = 16
+    ids = rng.integers(0, n, (NHOSTS, B)).astype(np.int32)
+    out = np.asarray(df.lookup(ids))
+    for h in range(NHOSTS):
+        np.testing.assert_allclose(out[h], full[ids[h]], rtol=1e-6)
+
+
+def test_dist_feature_parity_getitem(mesh, rng):
+    n, d = 64, 4
+    full = rng.normal(size=(n, d)).astype(np.float32)
+    g2h = rng.integers(0, NHOSTS, n).astype(np.int32)
+    info = PartitionInfo(host=2, hosts=NHOSTS, global2host=g2h)
+    df = DistFeature.from_global_feature(full, mesh, info)
+    ids = rng.integers(0, n, 16)
+    out = np.asarray(df[ids])
+    np.testing.assert_allclose(out, full[ids], rtol=1e-6)

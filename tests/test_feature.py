@@ -1,0 +1,83 @@
+"""Feature store tests — gather vs numpy fancy-index ground truth
+(parity: tests/python/cuda/test_shard_tensor.py:44-80, test_features.py)."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from quiver_tpu import CSRTopo, Feature
+from quiver_tpu.utils.mesh import make_mesh
+
+
+def _ground_truth_check(feature, full, ids):
+    got = np.asarray(feature[ids])
+    np.testing.assert_allclose(got, full[ids], rtol=1e-6)
+
+
+def test_full_cache_gather(small_graph, rng):
+    n = small_graph.node_count
+    full = rng.normal(size=(n, 16)).astype(np.float32)
+    f = Feature(device_cache_size="1G").from_cpu_tensor(full)
+    assert f.cache_count == n
+    ids = rng.integers(0, n, 64)
+    _ground_truth_check(f, full, ids)
+
+
+def test_partial_cache_gather_with_degree_order(small_graph, rng):
+    n = small_graph.node_count
+    full = rng.normal(size=(n, 8)).astype(np.float32)
+    row_bytes = 8 * 4
+    budget = row_bytes * (n // 4)  # cache 25%
+    f = Feature(device_cache_size=budget,
+                csr_topo=small_graph).from_cpu_tensor(full.copy())
+    assert 0 < f.cache_count < n
+    assert f.feature_order is not None
+    ids = rng.integers(0, n, 100)
+    _ground_truth_check(f, full, ids)
+    # hot rows are the high-degree ones
+    deg = small_graph.degree
+    hot_old_ids = np.nonzero(f.feature_order < f.cache_count)[0]
+    cold_old_ids = np.nonzero(f.feature_order >= f.cache_count)[0]
+    assert deg[hot_old_ids].min() >= deg[cold_old_ids].max() - 1e-9
+
+
+def test_zero_cache(small_graph, rng):
+    n = small_graph.node_count
+    full = rng.normal(size=(n, 8)).astype(np.float32)
+    f = Feature(device_cache_size=0).from_cpu_tensor(full)
+    assert f.cache_count == 0
+    ids = rng.integers(0, n, 32)
+    _ground_truth_check(f, full, ids)
+
+
+def test_ici_shard_policy(rng):
+    n = 64
+    full = rng.normal(size=(n, 4)).astype(np.float32)
+    mesh = make_mesh(("data",))
+    f = Feature(device_cache_size="1G", cache_policy="p2p_clique_replicate",
+                mesh=mesh).from_cpu_tensor(full)
+    assert f.cache_count == n
+    ids = rng.integers(0, n, 16)
+    _ground_truth_check(f, full, ids)
+
+
+def test_from_mmap(tmp_path, rng):
+    full = rng.normal(size=(100, 8)).astype(np.float32)
+    p = str(tmp_path / "feat.npy")
+    np.save(p, full)
+    f = Feature.from_mmap(p, device_cache_size=8 * 4 * 30)
+    assert f.cache_count == 30
+    ids = rng.integers(0, 100, 40)
+    _ground_truth_check(f, full, ids)
+
+
+def test_ipc_parity_roundtrip(small_graph, rng):
+    n = small_graph.node_count
+    full = rng.normal(size=(n, 8)).astype(np.float32)
+    f = Feature(device_cache_size="1G").from_cpu_tensor(full)
+    handle = f.share_ipc()
+    g = Feature.lazy_from_ipc_handle(handle)
+    ids = rng.integers(0, n, 16)
+    _ground_truth_check(g, full, ids)
+    assert g.cache_count == n

@@ -1,0 +1,97 @@
+"""Sampling property tests — the rebuild of the reference's C++ suite
+(`is_sample_valid`, tests/cpp/test_quiver_cpp:33-50): sampled neighbors are
+a subset of true neighbors, counts == min(deg, k), distinct when deg >= k.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from quiver_tpu.ops.sample import sample_neighbors, to_ragged
+
+
+def true_neighbors(topo, v):
+    return set(topo.indices[topo.indptr[v]: topo.indptr[v + 1]].tolist())
+
+
+@pytest.mark.parametrize("k", [1, 4, 16])
+def test_sample_valid_subset(small_graph, k):
+    indptr, indices = small_graph.to_device()
+    seeds = np.arange(small_graph.node_count, dtype=np.int32)
+    out = sample_neighbors(indptr, indices, jnp.asarray(seeds), k,
+                           jax.random.PRNGKey(0))
+    nbrs = np.asarray(out.nbrs)
+    mask = np.asarray(out.mask)
+    counts = np.asarray(out.counts)
+    deg = small_graph.degree
+    np.testing.assert_array_equal(counts, np.minimum(deg, k))
+    for v in seeds:
+        tn = true_neighbors(small_graph, v)
+        got = nbrs[v][mask[v]].tolist()
+        assert len(got) == min(deg[v], k)
+        assert set(got) <= tn, (v, got, tn)
+        # distinctness (without replacement)
+        assert len(set(got)) == len(got)
+
+
+def test_sample_masked_seeds(small_graph):
+    indptr, indices = small_graph.to_device()
+    seeds = jnp.asarray(np.array([1, 2, 3, 4], dtype=np.int32))
+    sm = jnp.asarray(np.array([True, False, True, False]))
+    out = sample_neighbors(indptr, indices, seeds, 4,
+                           jax.random.PRNGKey(1), seed_mask=sm)
+    counts = np.asarray(out.counts)
+    assert counts[1] == 0 and counts[3] == 0
+    assert not np.asarray(out.mask)[1].any()
+
+
+def test_sample_randomness_covers_neighbors(small_graph):
+    """Over many draws every neighbor of a high-degree node appears."""
+    indptr, indices = small_graph.to_device()
+    deg = small_graph.degree
+    v = int(np.argmax(deg))
+    k = max(2, int(deg[v]) // 2)
+    seen = set()
+    for i in range(50):
+        out = sample_neighbors(indptr, indices,
+                               jnp.asarray([v], dtype=jnp.int32), k,
+                               jax.random.PRNGKey(i))
+        seen |= set(np.asarray(out.nbrs)[0][np.asarray(out.mask)[0]].tolist())
+    assert seen == true_neighbors(small_graph, v)
+
+
+def test_sample_marginals_uniformish(small_graph):
+    """Inclusion frequency of each neighbor ~ k/deg (chi-square-ish bound)."""
+    indptr, indices = small_graph.to_device()
+    deg = small_graph.degree
+    v = int(np.argmax(deg))
+    d = int(deg[v])
+    k = d // 2
+    trials = 400
+    counts = {}
+    for i in range(trials):
+        out = sample_neighbors(indptr, indices,
+                               jnp.asarray([v], dtype=jnp.int32), k,
+                               jax.random.PRNGKey(1000 + i))
+        for x in np.asarray(out.nbrs)[0][np.asarray(out.mask)[0]].tolist():
+            counts[x] = counts.get(x, 0) + 1
+    expect = trials * k / d
+    for x, c in counts.items():
+        assert abs(c - expect) < 6 * np.sqrt(expect), (x, c, expect)
+
+
+def test_to_ragged_matches_reference_contract(small_graph):
+    indptr, indices = small_graph.to_device()
+    seeds = jnp.asarray(np.array([0, 1, 2, 3, 4], dtype=np.int32))
+    out = sample_neighbors(indptr, indices, seeds, 3, jax.random.PRNGKey(2))
+    flat, counts = to_ragged(out)
+    flat, counts = np.asarray(flat), np.asarray(counts)
+    off = 0
+    nbrs = np.asarray(out.nbrs)
+    mask = np.asarray(out.mask)
+    for b in range(5):
+        got = flat[off: off + counts[b]].tolist()
+        assert got == nbrs[b][mask[b]].tolist()
+        off += counts[b]
+    assert off == len(flat)

@@ -1,0 +1,67 @@
+import numpy as np
+import pytest
+
+from quiver_tpu import CSRTopo, coo_to_csr, parse_size
+from quiver_tpu.utils.topology import reindex_feature
+
+
+def test_coo_to_csr_roundtrip():
+    src = np.array([0, 0, 1, 2, 2, 2, 4])
+    dst = np.array([1, 2, 0, 0, 1, 3, 4])
+    indptr, indices, eid = coo_to_csr(src, dst)
+    assert indptr.tolist() == [0, 2, 3, 6, 6, 7]
+    assert sorted(indices[0:2].tolist()) == [1, 2]
+    assert sorted(indices[3:6].tolist()) == [0, 1, 3]
+    # eid maps back to original edge positions
+    assert (dst[eid] == indices).all()
+
+
+def test_csr_topo_from_edge_index():
+    src = np.array([0, 1, 1, 2])
+    dst = np.array([1, 0, 2, 1])
+    topo = CSRTopo(edge_index=np.stack([src, dst]))
+    assert topo.node_count == 3
+    assert topo.edge_count == 4
+    assert topo.degree.tolist() == [1, 2, 1]
+
+
+def test_csr_topo_from_indptr():
+    topo = CSRTopo(indptr=np.array([0, 1, 3]), indices=np.array([1, 0, 1]))
+    assert topo.node_count == 2
+    assert topo.edge_count == 3
+
+
+def test_parse_size():
+    assert parse_size(1024) == 1024
+    assert parse_size("1K") == 1024
+    assert parse_size("1KB") == 1024
+    assert parse_size("1.5M") == int(1.5 * 2**20)
+    assert parse_size("2GB") == 2 * 2**30
+    with pytest.raises(ValueError):
+        parse_size("abc")
+
+
+def test_reindex_feature_hot_prefix(small_graph):
+    n = small_graph.node_count
+    feat = np.arange(n, dtype=np.float32)[:, None] * np.ones((1, 4),
+                                                            np.float32)
+    ratio = 0.2
+    new_feat, new_order = reindex_feature(small_graph, feat, ratio)
+    hot = int(n * ratio)
+    # permutation property
+    assert sorted(new_order.tolist()) == list(range(n))
+    # row i of new_feat is old row prev_order[i]; new_order[old] = new row
+    old_ids = new_feat[:, 0].astype(np.int64)
+    assert (new_order[old_ids] == np.arange(n)).all()
+    # hot prefix contains the top-degree nodes (as a set)
+    deg = small_graph.degree
+    top = set(np.argsort(-deg, kind="stable")[:hot].tolist())
+    assert set(old_ids[:hot].tolist()) == top
+
+
+def test_to_device_roundtrip(small_graph):
+    indptr, indices = small_graph.to_device()
+    assert indptr.shape[0] == small_graph.node_count + 1
+    np.testing.assert_array_equal(
+        np.asarray(indices), small_graph.indices.astype(np.int32)
+    )
