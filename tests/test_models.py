@@ -1,0 +1,78 @@
+"""Model forward-pass tests over sampled dense blocks."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from quiver_tpu import GraphSageSampler
+from quiver_tpu.models import GraphSAGE, GAT, SAGEConv
+
+
+@pytest.fixture
+def sampled(small_graph):
+    s = GraphSageSampler(small_graph, [4, 3])
+    seeds = np.arange(16, dtype=np.int64)
+    return s.sample(seeds, key=jax.random.PRNGKey(0))
+
+
+def test_sage_forward(sampled, rng):
+    x = jnp.asarray(rng.normal(size=(sampled.n_id.shape[0], 12)),
+                    jnp.float32)
+    model = GraphSAGE(hidden=32, out_dim=5, num_layers=2)
+    params = model.init(jax.random.PRNGKey(0), x, sampled.layers)
+    out = model.apply(params, x, sampled.layers)
+    assert out.shape == (16, 5)
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_gat_forward(sampled, rng):
+    x = jnp.asarray(rng.normal(size=(sampled.n_id.shape[0], 12)),
+                    jnp.float32)
+    model = GAT(hidden=8, out_dim=5, num_layers=2, heads=2)
+    params = model.init(jax.random.PRNGKey(0), x, sampled.layers)
+    out = model.apply(params, x, sampled.layers)
+    assert out.shape == (16, 5)
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_sageconv_mean_matches_manual(small_graph, rng):
+    """SAGEConv aggregation equals a hand-computed masked mean."""
+    s = GraphSageSampler(small_graph, [4])
+    seeds = np.arange(8, dtype=np.int64)
+    b = s.sample(seeds, key=jax.random.PRNGKey(1))
+    blk = b.layers[0]
+    x = jnp.asarray(rng.normal(size=(b.n_id.shape[0], 6)), jnp.float32)
+    conv = SAGEConv(7)
+    params = conv.init(jax.random.PRNGKey(0), x, blk)
+    out = np.asarray(conv.apply(params, x, blk))
+
+    w_self = np.asarray(params["params"]["lin_self"]["kernel"])
+    b_self = np.asarray(params["params"]["lin_self"]["bias"])
+    w_nbr = np.asarray(params["params"]["lin_nbr"]["kernel"])
+    xs = np.asarray(x)
+    local = np.asarray(blk.nbr_local)
+    m = np.asarray(blk.mask)
+    for i in range(8):
+        nb = xs[local[i][m[i]]]
+        mean = nb.mean(axis=0) if len(nb) else np.zeros(6)
+        ref = xs[i] @ w_self + b_self + mean @ w_nbr
+        np.testing.assert_allclose(out[i], ref, rtol=1e-4, atol=1e-5)
+
+
+def test_masked_padding_does_not_leak(small_graph, rng):
+    """Changing features of masked (padding) frontier rows must not change
+    the model output for valid targets."""
+    s = GraphSageSampler(small_graph, [4, 3])
+    seeds = np.arange(8, dtype=np.int64)
+    b = s.sample(seeds, key=jax.random.PRNGKey(2))
+    P = b.n_id.shape[0]
+    x1 = rng.normal(size=(P, 6)).astype(np.float32)
+    x2 = x1.copy()
+    pad = ~np.asarray(b.n_id_mask)
+    x2[pad] = 1e6  # poison padding rows
+    model = GraphSAGE(hidden=16, out_dim=3, num_layers=2)
+    params = model.init(jax.random.PRNGKey(0), jnp.asarray(x1), b.layers)
+    o1 = np.asarray(model.apply(params, jnp.asarray(x1), b.layers))
+    o2 = np.asarray(model.apply(params, jnp.asarray(x2), b.layers))
+    np.testing.assert_allclose(o1[:8], o2[:8], rtol=1e-5)

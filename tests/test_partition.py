@@ -1,0 +1,67 @@
+"""Partitioner tests (parity: reference partition.py semantics)."""
+
+import numpy as np
+import pytest
+
+from quiver_tpu import (
+    partition_without_replication,
+    quiver_partition_feature,
+    load_quiver_feature_partition,
+)
+from quiver_tpu.partition import (
+    select_nodes,
+    partition_feature_without_replication,
+)
+
+
+@pytest.fixture
+def probs(rng):
+    n = 200
+    # two partitions with disjoint-ish hot sets
+    p0 = np.zeros(n)
+    p1 = np.zeros(n)
+    p0[:80] = rng.uniform(0.5, 1.0, 80)
+    p1[60:140] = rng.uniform(0.5, 1.0, 80)
+    return [p0, p1]
+
+
+def test_partition_complete_and_disjoint(probs):
+    parts = partition_without_replication(probs)
+    allv = np.concatenate(parts)
+    assert len(allv) == len(set(allv.tolist())) == len(probs[0])
+    # balanced within a chunk's worth
+    assert abs(len(parts[0]) - len(parts[1])) <= len(probs[0]) // 16
+
+
+def test_partition_affinity(probs):
+    """Nodes accessed only by partition 0 should mostly land there."""
+    parts = partition_without_replication(probs)
+    only0 = set(range(0, 60))
+    placed0 = only0 & set(parts[0].tolist())
+    # balance constraint legitimately displaces a few exclusive nodes
+    assert len(placed0) > len(only0) * 0.8
+
+
+def test_select_nodes(probs):
+    accessed, unaccessed = select_nodes(probs)
+    assert set(accessed.tolist()) == set(range(140))
+    assert set(unaccessed.tolist()) == set(range(140, 200))
+
+
+def test_feature_partition_roundtrip(tmp_path, probs, rng):
+    n = len(probs[0])
+    feature = rng.normal(size=(n, 8)).astype(np.float32)
+    parts, orders, book = quiver_partition_feature(
+        feature, probs, str(tmp_path)
+    )
+    for p in range(2):
+        ids, cache_order, feat_p, book_l = load_quiver_feature_partition(
+            p, str(tmp_path)
+        )
+        np.testing.assert_allclose(feat_p, feature[ids])
+        assert (book_l[ids] == p).all()
+        # cache order is probability-descending within the partition
+        pr = probs[p][cache_order]
+        assert (np.diff(pr) <= 1e-12).all()
+    # every node has a home
+    assert (book >= 0).all()

@@ -1,0 +1,93 @@
+"""Serving pipeline tests: batcher routing, hybrid sampling, end-to-end
+inference with latency stats (parity: reference serving.py behavior)."""
+
+import queue
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from quiver_tpu import (
+    CSRTopo, Feature, GraphSageSampler, RequestBatcher, HybridSampler,
+    InferenceServer, InferenceServer_Debug, generate_neighbour_num,
+)
+from quiver_tpu.serving import ServingRequest
+from quiver_tpu.models import GraphSAGE
+
+
+def test_batcher_routing(small_graph):
+    nn_num = generate_neighbour_num(small_graph, [4, 3], mode="expected")
+    q = queue.Queue()
+    rb = RequestBatcher([q], neighbour_num=nn_num,
+                        threshold=float(np.median(nn_num) * 4),
+                        mode="Auto").start()
+    deg = small_graph.degree
+    light = np.argsort(deg)[:2]          # low-degree -> CPU lane
+    heavy = np.argsort(deg)[-16:]        # high-degree batch -> TPU lane
+    q.put(ServingRequest(ids=light, client=0, seq=0))
+    q.put(ServingRequest(ids=heavy, client=0, seq=1))
+    time.sleep(0.3)
+    rb.stop()
+    cpu_items, dev_items = [], []
+    while not rb.cpu_batched_queue.empty():
+        it = rb.cpu_batched_queue.get()
+        if isinstance(it, ServingRequest):
+            cpu_items.append(it)
+    while not rb.device_batched_queue.empty():
+        it = rb.device_batched_queue.get()
+        if isinstance(it, ServingRequest):
+            dev_items.append(it)
+    assert len(cpu_items) == 1 and cpu_items[0].seq == 0
+    assert len(dev_items) == 1 and dev_items[0].seq == 1
+
+
+def test_end_to_end_serving(small_graph, rng):
+    n = small_graph.node_count
+    feat = rng.normal(size=(n, 8)).astype(np.float32)
+    feature = Feature(device_cache_size="1G").from_cpu_tensor(feat)
+    sizes = [4, 3]
+    tpu_sampler = GraphSageSampler(small_graph, sizes)
+    cpu_sampler = GraphSageSampler(small_graph, sizes, mode="CPU")
+    model = GraphSAGE(hidden=16, out_dim=3, num_layers=2, dropout=0.0)
+    seeds0 = np.arange(8, dtype=np.int64)
+    b0 = tpu_sampler.sample(seeds0)
+    x0 = feature[np.asarray(b0.n_id)]
+    params = model.init(jax.random.PRNGKey(0), x0, b0.layers)
+    apply_fn = jax.jit(
+        lambda p, x, blocks: model.apply(p, x, blocks)
+    )
+
+    nn_num = generate_neighbour_num(small_graph, sizes, mode="expected")
+    stream = queue.Queue()
+    rb = RequestBatcher([stream], neighbour_num=nn_num,
+                        threshold=float(np.percentile(nn_num, 50) * 8),
+                        mode="Auto").start()
+    hs = HybridSampler(cpu_sampler, rb.cpu_batched_queue,
+                       num_workers=2).start()
+    server = InferenceServer_Debug(
+        tpu_sampler, feature, apply_fn, params,
+        rb.device_batched_queue, hs.sampled_queue,
+    ).start()
+
+    n_req = 12
+    for i in range(n_req):
+        ids = rng.integers(0, n, rng.integers(1, 16))
+        stream.put(ServingRequest(ids=ids, client=0, seq=i))
+
+    results = []
+    for _ in range(n_req):
+        results.append(server.result_queue.get(timeout=60))
+    rb.stop()
+    hs.stop()
+    server.stop()
+
+    assert len(results) == n_req
+    for req, out in results:
+        assert out.shape == (len(req.ids), 3)
+        assert np.isfinite(out).all()
+    stats = server.stats()
+    assert stats["count"] == n_req
+    assert stats["p99_latency_ms"] >= stats["p50_latency_ms"]
+    assert stats["throughput_rps"] > 0
