@@ -1,0 +1,139 @@
+"""ogbn-products GraphSAGE training — the flagship example.
+
+TPU-native counterpart of
+``/root/reference/examples/pyg/ogbn_products_sage_quiver.py`` (quality bar
+from that file's header: test acc ~0.787).  Shows the same "3-line swap"
+shape: build CSRTopo -> GraphSageSampler -> Feature, then a normal training
+loop; everything device-side is jitted.
+
+Runs on the real dataset when OGB + the data are available
+(``--root``), otherwise generates a synthetic products-scale graph so the
+pipeline is exercisable anywhere (no-egress environments included).
+"""
+
+import argparse
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import optax
+
+from quiver_tpu import CSRTopo, Feature, GraphSageSampler
+from quiver_tpu.models import GraphSAGE
+from quiver_tpu.parallel import TrainState, make_train_step
+
+
+def load_dataset(root):
+    try:
+        from ogb.nodeproppred import NodePropPredDataset
+
+        ds = NodePropPredDataset("ogbn-products", root=root)
+        graph, labels = ds[0]
+        split = ds.get_idx_split()
+        src, dst = graph["edge_index"]
+        # symmetrize like PyG's to_undirected
+        s = np.concatenate([src, dst])
+        d = np.concatenate([dst, src])
+        topo = CSRTopo(edge_index=np.stack([s, d]))
+        return (topo, graph["node_feat"].astype(np.float32),
+                labels.squeeze().astype(np.int32),
+                split["train"], split["valid"], split["test"], 47)
+    except Exception as e:
+        print(f"[synthetic fallback: {e}]")
+        rng = np.random.default_rng(0)
+        n, n_cls = 200_000, 47
+        comm = rng.integers(0, n_cls, n)
+        deg = np.maximum(rng.lognormal(2.5, 1.0, n), 1).astype(np.int64)
+        src = np.repeat(np.arange(n), deg)
+        # 70% intra-community edges for learnability
+        intra = rng.random(len(src)) < 0.7
+        dst = np.where(
+            intra,
+            (src + rng.integers(1, 50, len(src)) * n_cls) % n,
+            rng.integers(0, n, len(src)),
+        )
+        topo = CSRTopo(edge_index=np.stack([src, dst]))
+        feat = np.eye(n_cls, dtype=np.float32)[comm]
+        feat = np.concatenate(
+            [feat, rng.normal(0, 0.5, (n, 100 - n_cls)).astype(np.float32)],
+            axis=1,
+        )
+        idx = rng.permutation(n)
+        return (topo, feat, comm.astype(np.int32),
+                idx[: n // 2], idx[n // 2: n * 3 // 4], idx[n * 3 // 4:],
+                n_cls)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--root", default="/data/products")
+    ap.add_argument("--epochs", type=int, default=3)
+    ap.add_argument("--batch-size", type=int, default=1024)
+    ap.add_argument("--cache", default="200M",
+                    help="device feature-cache budget (quiver.Feature)")
+    args = ap.parse_args()
+
+    topo, feat, labels, train_idx, valid_idx, _, n_cls = load_dataset(
+        args.root
+    )
+    print(f"graph: {topo.node_count:,} nodes, {topo.edge_count:,} edges")
+
+    # ---- the 3-line quiver swap ----------------------------------------
+    sampler = GraphSageSampler(topo, sizes=[15, 10, 5])
+    feature = Feature(device_cache_size=args.cache,
+                      csr_topo=topo).from_cpu_tensor(feat)
+    # --------------------------------------------------------------------
+
+    model = GraphSAGE(hidden=256, out_dim=n_cls, num_layers=3)
+    tx = optax.adam(3e-3)
+    B = args.batch_size
+
+    seeds0 = train_idx[:B]
+    b0 = sampler.sample(seeds0)
+    x0 = feature[np.asarray(b0.n_id)]
+    params = model.init(jax.random.PRNGKey(0), x0, b0.layers)
+    state = TrainState.create(params, tx)
+    step = make_train_step(
+        lambda p, x, blocks, train=False, rngs=None: model.apply(
+            p, x, blocks, train=train, rngs=rngs
+        ),
+        tx,
+    )
+
+    rng = np.random.default_rng(1)
+    for epoch in range(args.epochs):
+        order = rng.permutation(len(train_idx))
+        t0 = time.perf_counter()
+        losses = []
+        n_batches = len(train_idx) // B
+        for i in range(n_batches):
+            seeds = train_idx[order[i * B: (i + 1) * B]]
+            batch = sampler.sample(seeds, key=jax.random.PRNGKey(
+                epoch * n_batches + i))
+            x = feature[np.asarray(batch.n_id)]
+            lab = jnp.asarray(labels[seeds])
+            state, loss = step(state, x, batch.layers, lab,
+                               jnp.ones((B,), bool),
+                               jax.random.PRNGKey(10_000 + i))
+            losses.append(loss)
+        jax.block_until_ready(losses[-1])
+        dt = time.perf_counter() - t0
+        print(f"epoch {epoch}: {dt:.2f}s, loss {np.mean(jax.device_get(jnp.stack(losses))):.4f}")
+
+        # quick validation accuracy on a few batches
+        correct = total = 0
+        for i in range(min(10, len(valid_idx) // B)):
+            seeds = valid_idx[i * B: (i + 1) * B]
+            batch = sampler.sample(seeds)
+            x = feature[np.asarray(batch.n_id)]
+            logits = model.apply(state.params, x, batch.layers)
+            pred = np.asarray(jnp.argmax(logits, -1))
+            correct += (pred == labels[seeds]).sum()
+            total += len(seeds)
+        if total:
+            print(f"  val acc (sampled): {correct / total:.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -80,6 +80,47 @@ class SampledBatch(NamedTuple):
         )
 
 
+def _sample_pipeline_nodedup(indptr, indices, seeds, key, sizes):
+    """Traced multi-hop pipeline WITHOUT dedup — the TPU hot path.
+
+    Design note (why no hash table / no sort): the reference dedups every
+    hop because on GPU the saved gathers/compute outweigh a hash-table
+    kernel (reindex.cu.hpp).  On TPU the trade inverts: sort/searchsorted/
+    scatter are the *slow* ops (measured: a hop-3-sized sort costs ~10x the
+    sampling itself) while the MXU/HBM make duplicated frontier rows nearly
+    free.  So the hot path relabels **positionally**: the hop-l frontier is
+    ``concat(prev_frontier, sampled_nbrs.flat)`` and neighbor j of target b
+    lives at position ``P_prev + b*k + j`` — no table, no sort, no scatter.
+    Duplicate nodes compute duplicate embeddings (= original GraphSAGE
+    tree-expansion semantics); validity masks carry through.  Exact-dedup
+    per hop stays available via ``dedup="hop"`` for parity.
+    """
+    B = seeds.shape[0]
+    frontier = seeds.astype(jnp.int32)
+    fmask = jnp.ones((B,), dtype=bool)
+    blocks = []
+    keys = jax.random.split(key, len(sizes))
+    for l, k in enumerate(sizes):
+        out = sample_neighbors(indptr, indices, frontier, k, keys[l],
+                               seed_mask=fmask)
+        t = frontier.shape[0]
+        pos = (t + jnp.arange(t, dtype=jnp.int32)[:, None] * k
+               + jnp.arange(k, dtype=jnp.int32)[None, :])
+        blocks.append(
+            LayerBlock(
+                nbr_local=jnp.where(out.mask, pos, 0),
+                mask=out.mask,
+                num_targets=fmask.sum().astype(jnp.int32),
+            )
+        )
+        frontier = jnp.concatenate(
+            [frontier, jnp.where(out.mask, out.nbrs, 0).reshape(-1)]
+        )
+        fmask = jnp.concatenate([fmask, out.mask.reshape(-1)])
+    num_nodes = fmask.sum().astype(jnp.int32)
+    return frontier, fmask, num_nodes, tuple(blocks[::-1])
+
+
 def _sample_pipeline(indptr, indices, seeds, key, sizes, caps):
     """Traced multi-hop pipeline: outward sampling with per-hop dedup."""
     B = seeds.shape[0]
@@ -122,18 +163,24 @@ class GraphSageSampler:
       device: jax device for the topology (None = default).
       mode: ``"TPU"`` (jit, default) or ``"CPU"`` (native host sampler).
       frontier_caps: optional per-layer cap on the padded frontier size
-        (see module docstring).  ``None`` entries = exact.
+        (see module docstring).  Only meaningful with ``dedup="hop"``.
+      dedup: ``"none"`` (default, TPU hot path — positional relabel, no
+        sort; frontier may contain duplicate nodes) or ``"hop"``
+        (reference-parity exact dedup each hop via ``ops.reindex``).
     """
 
     def __init__(self, csr_topo: CSRTopo, sizes: Sequence[int], device=None,
                  mode: str = "TPU",
-                 frontier_caps: Optional[Sequence[Optional[int]]] = None):
+                 frontier_caps: Optional[Sequence[Optional[int]]] = None,
+                 dedup: str = "none"):
         assert mode in ("TPU", "CPU", "UVA", "GPU"), mode
         if mode in ("UVA", "GPU"):  # compat aliases from the reference API
             mode = "TPU"
+        assert dedup in ("none", "hop"), dedup
         self.csr_topo = csr_topo
         self.sizes = list(sizes)
         self.mode = mode
+        self.dedup = dedup
         self.device = device
         self.frontier_caps = (
             list(frontier_caps) if frontier_caps is not None
@@ -161,9 +208,13 @@ class GraphSageSampler:
         indptr, indices = self.csr_topo.to_device(self.device)
         sizes = tuple(self.sizes)
         caps = tuple(self.frontier_caps)
+        dedup = self.dedup
 
         @jax.jit
         def fn(seeds, key):
+            if dedup == "none":
+                return _sample_pipeline_nodedup(indptr, indices, seeds, key,
+                                                sizes)
             return _sample_pipeline(indptr, indices, seeds, key, sizes, caps)
 
         return fn
