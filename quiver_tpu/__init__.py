@@ -31,6 +31,12 @@ from .partition import (
     quiver_partition_feature,
     load_quiver_feature_partition,
 )
+from .hetero import (
+    HeteroCSRTopo,
+    HeteroGraphSageSampler,
+    HeteroSampledBatch,
+    HeteroLayerBlock,
+)
 from .neighbour_num import generate_neighbour_num
 from .serving import (
     RequestBatcher,
@@ -46,6 +52,8 @@ __all__ = [
     "MeshTopo", "make_mesh",
     "GraphSageSampler", "SampledBatch", "LayerBlock",
     "MixedGraphSageSampler", "SampleJob",
+    "HeteroCSRTopo", "HeteroGraphSageSampler", "HeteroSampledBatch",
+    "HeteroLayerBlock",
     "Feature", "DeviceConfig",
     "DistFeature", "PartitionInfo", "TpuComm",
     "partition_without_replication", "quiver_partition_feature",

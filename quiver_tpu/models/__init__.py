@@ -1,3 +1,4 @@
 from .layers import SAGEConv, GATConv
 from .sage import GraphSAGE
 from .gat import GAT
+from .rgat import RGAT

@@ -1,0 +1,135 @@
+"""Hetero sampler + R-GAT tests (mag240m-style 3-type schema)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from quiver_tpu.hetero import HeteroCSRTopo, HeteroGraphSageSampler
+from quiver_tpu.models.rgat import RGAT
+
+
+N_PAPER, N_AUTHOR, N_INST = 300, 200, 40
+
+
+@pytest.fixture(scope="module")
+def mag_topo():
+    rng = np.random.default_rng(0)
+
+    def edges(n_src, n_dst, avg):
+        deg = rng.poisson(avg, n_dst)
+        dst = np.repeat(np.arange(n_dst), deg)
+        src = rng.integers(0, n_src, len(dst))
+        return np.stack([src, dst])
+
+    ei = {
+        ("paper", "cites", "paper"): edges(N_PAPER, N_PAPER, 6),
+        ("author", "writes", "paper"): edges(N_AUTHOR, N_PAPER, 3),
+        ("institution", "employs", "author"): edges(N_INST, N_AUTHOR, 2),
+    }
+    return HeteroCSRTopo.from_edge_index_dict(
+        ei, {"paper": N_PAPER, "author": N_AUTHOR, "institution": N_INST}
+    ), ei
+
+
+def test_hetero_sample_shapes(mag_topo):
+    topo, _ = mag_topo
+    s = HeteroGraphSageSampler(topo, sizes=4, num_hops=2, seed_type="paper")
+    seeds = np.arange(16)
+    b = s.sample(seeds, key=jax.random.PRNGKey(0))
+    assert b.batch_size == 16
+    assert len(b.layers) == 2
+    # paper frontier grows from seeds; author/institution appear
+    assert b.n_id["paper"].shape[0] > 16
+    assert b.n_id["author"].shape[0] > 0
+    # hop1 (outermost processed last... layers are outermost-first):
+    # the innermost hop must have paper targets == seeds
+    inner = b.layers[-1]
+    paper_blocks = [blk for blk in inner
+                    if blk.relation[2] == "paper"]
+    assert paper_blocks and all(
+        int(blk.num_targets) == 16 for blk in paper_blocks
+    )
+
+
+def test_hetero_edges_are_real(mag_topo):
+    topo, ei = mag_topo
+    s = HeteroGraphSageSampler(topo, sizes=3, num_hops=2, seed_type="paper")
+    seeds = np.arange(12)
+    b = s.sample(seeds, key=jax.random.PRNGKey(1))
+    for hop_blocks in b.layers:
+        for blk in hop_blocks:
+            s_t, _, d_t = blk.relation
+            rel_topo = topo.relations[blk.relation]
+            n_src = np.asarray(b.n_id[s_t])
+            n_dst = np.asarray(b.n_id[d_t])
+            m = np.asarray(blk.mask)
+            local = np.asarray(blk.nbr_local)
+            dmask = np.asarray(b.n_id_mask[d_t])
+            for t in range(min(local.shape[0], 24)):
+                if not dmask[t]:
+                    assert not m[t].any()
+                    continue
+                tgt = n_dst[t]
+                row = set(rel_topo.indices[
+                    rel_topo.indptr[tgt]: rel_topo.indptr[tgt + 1]
+                ].tolist())
+                for j in range(local.shape[1]):
+                    if m[t, j]:
+                        assert n_src[local[t, j]] in row
+
+
+def test_rgat_forward(mag_topo, rng):
+    topo, _ = mag_topo
+    s = HeteroGraphSageSampler(topo, sizes=3, num_hops=2, seed_type="paper")
+    seeds = np.arange(8)
+    b = s.sample(seeds, key=jax.random.PRNGKey(2))
+    dims = {"paper": 16, "author": 8, "institution": 4}
+    xs = {
+        t: jnp.asarray(
+            rng.normal(size=(b.n_id[t].shape[0], dims[t])), jnp.float32
+        )
+        for t in dims
+    }
+    model = RGAT(hidden=16, out_dim=5, num_layers=2, in_dims=dims,
+                 heads=2, dropout=0.0)
+    params = model.init(jax.random.PRNGKey(0), xs, b)
+    out = model.apply(params, xs, b)
+    assert out.shape == (8, 5)
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_rgat_trains(mag_topo, rng):
+    """One gradient step decreases loss on a fixed batch."""
+    import optax
+
+    topo, _ = mag_topo
+    s = HeteroGraphSageSampler(topo, sizes=3, num_hops=2, seed_type="paper")
+    seeds = np.arange(16)
+    b = s.sample(seeds, key=jax.random.PRNGKey(3))
+    dims = {"paper": 16, "author": 8, "institution": 4}
+    xs = {
+        t: jnp.asarray(
+            rng.normal(size=(b.n_id[t].shape[0], dims[t])), jnp.float32
+        )
+        for t in dims
+    }
+    labels = jnp.asarray(rng.integers(0, 5, 16))
+    model = RGAT(hidden=16, out_dim=5, num_layers=2, in_dims=dims,
+                 heads=2, dropout=0.0)
+    params = model.init(jax.random.PRNGKey(0), xs, b)
+    tx = optax.adam(1e-2)
+    opt = tx.init(params)
+
+    def loss_fn(p):
+        logits = model.apply(p, xs, b)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels
+        ).mean()
+
+    l0 = loss_fn(params)
+    for _ in range(5):
+        g = jax.grad(loss_fn)(params)
+        upd, opt = tx.update(g, opt, params)
+        params = optax.apply_updates(params, upd)
+    assert float(loss_fn(params)) < float(l0)
