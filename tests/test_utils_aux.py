@@ -1,0 +1,83 @@
+"""Tests for tracing, checkpointing, prefetcher, mesh topo."""
+
+import numpy as np
+import pytest
+
+from quiver_tpu.utils import trace as trace_mod
+from quiver_tpu.utils.trace import (
+    trace_scope, Timer, trace_summary, reset_trace, show_tensor_info,
+)
+from quiver_tpu.utils.checkpoint import (
+    save_checkpoint, load_checkpoint, latest_checkpoint,
+)
+from quiver_tpu.utils.mesh import MeshTopo
+from quiver_tpu.parallel.prefetch import Prefetcher, AsyncNeighborSampler
+
+
+def test_trace_scope_aggregates():
+    trace_mod.set_enabled(True)
+    reset_trace()
+    for _ in range(3):
+        with trace_scope("unit"):
+            pass
+    s = trace_summary()
+    assert s["unit"]["count"] == 3
+    trace_mod.set_enabled(False)
+
+
+def test_timer_prints():
+    lines = []
+    with Timer("t", printer=lines.append):
+        pass
+    assert lines and "t:" in lines[0]
+
+
+def test_show_tensor_info():
+    lines = []
+    show_tensor_info(np.zeros((2, 3)), "x", printer=lines.append)
+    assert "shape=(2, 3)" in lines[0]
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    import jax.numpy as jnp
+    import optax
+
+    from quiver_tpu.parallel import TrainState
+
+    tx = optax.adam(1e-3)
+    params = {"w": jnp.ones((3, 3)), "b": jnp.zeros(3)}
+    state = TrainState.create(params, tx)
+    f = save_checkpoint(str(tmp_path), state, step=7, extra={"note": "hi"})
+    assert latest_checkpoint(str(tmp_path)) == f
+    state2, step = load_checkpoint(str(tmp_path), state)
+    assert step == 7
+    np.testing.assert_array_equal(np.asarray(state2.params["w"]),
+                                  np.ones((3, 3)))
+    payload = load_checkpoint(f)
+    assert payload["extra"]["note"] == "hi"
+
+
+def test_prefetcher_order_and_exceptions():
+    out = list(Prefetcher(range(5), lambda i: i * i, depth=2))
+    assert out == [0, 1, 4, 9, 16]
+
+    def boom(i):
+        if i == 2:
+            raise ValueError("x")
+        return i
+
+    with pytest.raises(ValueError):
+        list(Prefetcher(range(5), boom))
+
+
+def test_async_sampler(small_graph):
+    s = AsyncNeighborSampler(small_graph, k=4)
+    out = s.sample(np.arange(8))
+    assert out.nbrs.shape == (8, 4)
+
+
+def test_mesh_topo():
+    t = MeshTopo()
+    cliques = t.p2p_clique()
+    assert sum(len(v) for v in cliques.values()) == 8  # 8 virtual devices
+    assert "Clique" in t.info
