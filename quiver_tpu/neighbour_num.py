@@ -44,11 +44,14 @@ def generate_neighbour_num(
 
         indptr, indices = csr_topo.to_device()
         n = csr_topo.node_count
+        e = csr_topo.edge_count
+        indptr = indptr[: n + 1]   # strip lane padding
+        indices = indices[:e]
         deg = (indptr[1:] - indptr[:-1]).astype(jnp.float32)
         row_of_edge = (
             jnp.searchsorted(
                 indptr,
-                jnp.arange(indices.shape[0], dtype=indptr.dtype),
+                jnp.arange(e, dtype=indptr.dtype),
                 side="right",
             ) - 1
         )

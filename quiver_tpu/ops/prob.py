@@ -23,28 +23,37 @@ import jax.numpy as jnp
 __all__ = ["cal_neighbor_prob", "sample_prob"]
 
 
-@jax.jit
+@functools.partial(jax.jit, static_argnames=("num_edges",))
 def cal_neighbor_prob(indptr: jax.Array, indices: jax.Array,
-                      last_prob: jax.Array, k: int) -> jax.Array:
-    """One layer of the access-probability recurrence."""
-    n = indptr.shape[0] - 1
+                      last_prob: jax.Array, k,
+                      num_edges: int = None) -> jax.Array:
+    """One layer of the access-probability recurrence.
+
+    ``last_prob`` is ``[N]``; ``indptr``/``indices`` may be zero-padded
+    beyond ``N+1``/``num_edges`` (see ``CSRTopo.to_device``).
+    """
+    n = last_prob.shape[0]
+    e = num_edges if num_edges is not None else indices.shape[0]
+    indptr = indptr[: n + 1]
+    indices = indices[:e]
     deg = (indptr[1:] - indptr[:-1]).astype(jnp.float32)
     w = last_prob * jnp.minimum(1.0, k / jnp.maximum(deg, 1.0))
     # expand per-edge source weights: edge e belongs to row r(e)
     row_of_edge = jnp.searchsorted(
-        indptr, jnp.arange(indices.shape[0], dtype=indptr.dtype), side="right"
+        indptr, jnp.arange(e, dtype=indptr.dtype), side="right"
     ) - 1
     contrib = w[row_of_edge]
     return jax.ops.segment_sum(contrib, indices, num_segments=n)
 
 
 def sample_prob(indptr, indices, train_idx, total_node_count: int,
-                sizes: Sequence[int]) -> jax.Array:
+                sizes: Sequence[int], num_edges: int = None) -> jax.Array:
     """Multi-layer probability: parity with ``sample_prob``.
 
     Returns the last layer's accumulated probability vector (float32 [N]).
     """
     last = jnp.zeros((total_node_count,), jnp.float32).at[train_idx].set(1.0)
     for k in sizes:
-        last = cal_neighbor_prob(indptr, indices, last, k)
+        last = cal_neighbor_prob(indptr, indices, last, k,
+                                 num_edges=num_edges)
     return last

@@ -38,7 +38,23 @@ class SampleOut(NamedTuple):
     counts: jax.Array  # [B] int32 = min(degree, k), 0 for invalid seeds
 
 
-@functools.partial(jax.jit, static_argnames=("k",))
+def _gather(table: jax.Array, idx: jax.Array, mode: str) -> jax.Array:
+    """Element gather dispatch: 'xla' = jnp.take (clipped); 'lanes' = the
+    row-gather + lane-select path (``ops.fastgather``) that sidesteps XLA's
+    serialized 1-D scalar gather on TPU.  Requires the table to be padded
+    to a multiple of 128 (``CSRTopo.to_device`` guarantees it)."""
+    if mode == "lanes":
+        from .fastgather import element_gather
+
+        m = table.shape[0] // 128 * 128
+        return element_gather(
+            table[:m].reshape(-1, 128),
+            jnp.clip(idx, 0, m - 1),
+        )
+    return jnp.take(table, idx, mode="clip")
+
+
+@functools.partial(jax.jit, static_argnames=("k", "gather_mode"))
 def sample_neighbors(
     indptr: jax.Array,
     indices: jax.Array,
@@ -46,6 +62,7 @@ def sample_neighbors(
     k: int,
     key: jax.Array,
     seed_mask: Optional[jax.Array] = None,
+    gather_mode: str = "xla",
 ) -> SampleOut:
     """Sample up to ``k`` distinct neighbors per seed from a CSR graph.
 
@@ -64,8 +81,8 @@ def sample_neighbors(
     """
     seeds = seeds.astype(jnp.int32)
     B = seeds.shape[0]
-    start = jnp.take(indptr, seeds, mode="clip")
-    end = jnp.take(indptr, seeds + 1, mode="clip")
+    start = _gather(indptr, seeds, gather_mode)
+    end = _gather(indptr, seeds + 1, gather_mode)
     deg = end - start
     if seed_mask is not None:
         deg = jnp.where(seed_mask, deg, 0)
@@ -84,7 +101,7 @@ def sample_neighbors(
 
     mask = j < counts[:, None]
     idx = start[:, None] + pos
-    nbrs = jnp.take(indices, idx, mode="clip")
+    nbrs = _gather(indices, idx, gather_mode)
     nbrs = jnp.where(mask, nbrs, jnp.int32(-1))
     return SampleOut(nbrs=nbrs, mask=mask, counts=counts)
 

@@ -80,7 +80,8 @@ class SampledBatch(NamedTuple):
         )
 
 
-def _sample_pipeline_nodedup(indptr, indices, seeds, key, sizes):
+def _sample_pipeline_nodedup(indptr, indices, seeds, key, sizes,
+                             gather_mode="xla"):
     """Traced multi-hop pipeline WITHOUT dedup — the TPU hot path.
 
     Design note (why no hash table / no sort): the reference dedups every
@@ -102,7 +103,7 @@ def _sample_pipeline_nodedup(indptr, indices, seeds, key, sizes):
     keys = jax.random.split(key, len(sizes))
     for l, k in enumerate(sizes):
         out = sample_neighbors(indptr, indices, frontier, k, keys[l],
-                               seed_mask=fmask)
+                               seed_mask=fmask, gather_mode=gather_mode)
         t = frontier.shape[0]
         pos = (t + jnp.arange(t, dtype=jnp.int32)[:, None] * k
                + jnp.arange(k, dtype=jnp.int32)[None, :])
@@ -121,7 +122,8 @@ def _sample_pipeline_nodedup(indptr, indices, seeds, key, sizes):
     return frontier, fmask, num_nodes, tuple(blocks[::-1])
 
 
-def _sample_pipeline(indptr, indices, seeds, key, sizes, caps):
+def _sample_pipeline(indptr, indices, seeds, key, sizes, caps,
+                     gather_mode="xla"):
     """Traced multi-hop pipeline: outward sampling with per-hop dedup."""
     B = seeds.shape[0]
     frontier = seeds.astype(jnp.int32)
@@ -130,7 +132,7 @@ def _sample_pipeline(indptr, indices, seeds, key, sizes, caps):
     keys = jax.random.split(key, len(sizes))
     for l, (k, cap) in enumerate(zip(sizes, caps)):
         out = sample_neighbors(indptr, indices, frontier, k, keys[l],
-                               seed_mask=fmask)
+                               seed_mask=fmask, gather_mode=gather_mode)
         r = reindex(frontier, out.nbrs, out.mask, seed_mask=fmask)
         blocks.append(
             LayerBlock(
@@ -172,11 +174,19 @@ class GraphSageSampler:
     def __init__(self, csr_topo: CSRTopo, sizes: Sequence[int], device=None,
                  mode: str = "TPU",
                  frontier_caps: Optional[Sequence[Optional[int]]] = None,
-                 dedup: str = "none"):
+                 dedup: str = "none", gather_mode: str = "auto"):
         assert mode in ("TPU", "CPU", "UVA", "GPU"), mode
         if mode in ("UVA", "GPU"):  # compat aliases from the reference API
             mode = "TPU"
         assert dedup in ("none", "hop"), dedup
+        assert gather_mode in ("auto", "xla", "lanes"), gather_mode
+        if gather_mode == "auto":
+            # the lane-select gather pays off where XLA serializes 1-D
+            # scalar gathers (TPU); plain take is better on CPU
+            gather_mode = (
+                "lanes" if jax.default_backend() not in ("cpu",) else "xla"
+            )
+        self.gather_mode = gather_mode
         self.csr_topo = csr_topo
         self.sizes = list(sizes)
         self.mode = mode
@@ -209,13 +219,15 @@ class GraphSageSampler:
         sizes = tuple(self.sizes)
         caps = tuple(self.frontier_caps)
         dedup = self.dedup
+        gm = self.gather_mode
 
         @jax.jit
         def fn(seeds, key):
             if dedup == "none":
                 return _sample_pipeline_nodedup(indptr, indices, seeds, key,
-                                                sizes)
-            return _sample_pipeline(indptr, indices, seeds, key, sizes, caps)
+                                                sizes, gather_mode=gm)
+            return _sample_pipeline(indptr, indices, seeds, key, sizes, caps,
+                                    gather_mode=gm)
 
         return fn
 
@@ -267,7 +279,8 @@ class GraphSageSampler:
 
         indptr, indices = self.csr_topo.to_device(self.device)
         return _sp(indptr, indices, jnp.asarray(np.asarray(train_idx)),
-                   total_node_count, self.sizes)
+                   total_node_count, self.sizes,
+                   num_edges=self.csr_topo.edge_count)
 
     # -- spawn/IPC parity: jax is single-controller, nothing to share; keep
     #    the API so reference code ports 1:1 (sage_sampler.py:159-178). --

@@ -121,7 +121,12 @@ class CSRTopo:
     def to_device(self, device=None):
         """Place (indptr, indices) in device HBM as int32 jax Arrays.
 
-        Requires ``edge_count < 2**31``.  The result is cached on the object.
+        Both arrays are zero-padded to a multiple of 128 so the fast
+        lane-select gather (``ops.fastgather``) can view them as
+        ``[rows, 128]`` with a free in-jit reshape.  Padding is harmless to
+        the XLA-take path (real entries come first; callers never index
+        past ``node_count``/``edge_count``).  Requires
+        ``edge_count < 2**31``; larger graphs shard over the mesh.  Cached.
         """
         import jax
         import jax.numpy as jnp
@@ -132,8 +137,15 @@ class CSRTopo:
                     "edge_count >= 2^31: shard the graph (quiver_tpu.dist) "
                     "instead of single-device placement"
                 )
-            indptr = jnp.asarray(self.indptr_, dtype=jnp.int32)
-            indices = jnp.asarray(self.indices_, dtype=jnp.int32)
+
+            def pad128(a):
+                pad = (-len(a)) % 128
+                if pad:
+                    a = np.concatenate([a, np.zeros(pad, a.dtype)])
+                return a
+
+            indptr = jnp.asarray(pad128(self.indptr_.astype(np.int32)))
+            indices = jnp.asarray(pad128(self.indices_.astype(np.int32)))
             if device is not None:
                 indptr = jax.device_put(indptr, device)
                 indices = jax.device_put(indices, device)

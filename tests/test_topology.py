@@ -61,7 +61,13 @@ def test_reindex_feature_hot_prefix(small_graph):
 
 def test_to_device_roundtrip(small_graph):
     indptr, indices = small_graph.to_device()
-    assert indptr.shape[0] == small_graph.node_count + 1
+    n, e = small_graph.node_count, small_graph.edge_count
+    # padded to lane multiples for the fast-gather [rows, 128] view
+    assert indptr.shape[0] % 128 == 0 and indptr.shape[0] >= n + 1
+    assert indices.shape[0] % 128 == 0 and indices.shape[0] >= e
     np.testing.assert_array_equal(
-        np.asarray(indices), small_graph.indices.astype(np.int32)
+        np.asarray(indices)[:e], small_graph.indices.astype(np.int32)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(indptr)[: n + 1], small_graph.indptr.astype(np.int32)
     )
