@@ -26,6 +26,7 @@ from .mixed import MixedGraphSageSampler, SampleJob
 from .feature import Feature, DeviceConfig
 from .dist.feature import DistFeature, PartitionInfo
 from .dist.comm import TpuComm
+from .dist.sampler import DistGraphSampler
 from .partition import (
     partition_without_replication,
     quiver_partition_feature,
@@ -55,7 +56,7 @@ __all__ = [
     "HeteroCSRTopo", "HeteroGraphSageSampler", "HeteroSampledBatch",
     "HeteroLayerBlock",
     "Feature", "DeviceConfig",
-    "DistFeature", "PartitionInfo", "TpuComm",
+    "DistFeature", "PartitionInfo", "TpuComm", "DistGraphSampler",
     "partition_without_replication", "quiver_partition_feature",
     "load_quiver_feature_partition",
     "generate_neighbour_num",

@@ -1,2 +1,3 @@
 from .comm import TpuComm, getNcclId
 from .feature import DistFeature, PartitionInfo
+from .sampler import DistGraphSampler, shard_csr_by_rows

@@ -1,0 +1,71 @@
+"""Row-sharded distributed sampling over the 8-device virtual mesh."""
+
+import numpy as np
+import jax
+import pytest
+
+from quiver_tpu.dist.sampler import DistGraphSampler, shard_csr_by_rows
+from quiver_tpu.utils.mesh import make_mesh
+
+
+def test_shard_csr_by_rows(small_graph):
+    row_starts, lips, lids = shard_csr_by_rows(small_graph, 4)
+    assert row_starts[0] == 0 and row_starts[-1] == small_graph.node_count
+    # every edge lands in exactly one shard, contiguous rebuild matches
+    rebuilt = np.concatenate(lids)
+    np.testing.assert_array_equal(rebuilt, small_graph.indices)
+    for s in range(4):
+        lo, hi = row_starts[s], row_starts[s + 1]
+        np.testing.assert_array_equal(
+            lips[s],
+            small_graph.indptr[lo: hi + 1] - small_graph.indptr[lo],
+        )
+
+
+def test_dist_sampler_edges_real(small_graph):
+    mesh = make_mesh(("data",))
+    s = DistGraphSampler(small_graph, mesh, sizes=[4, 3])
+    rng = np.random.default_rng(0)
+    B = 16
+    seeds = rng.integers(0, small_graph.node_count, (8, B))
+    n_id, n_mask, num, blocks = s.sample(seeds, key=7)
+    n_id = np.asarray(n_id)
+    n_mask = np.asarray(n_mask)
+    assert n_id.shape[0] == 8
+    # seeds occupy the frontier prefix per shard
+    np.testing.assert_array_equal(n_id[:, :B], seeds)
+    # spot-check sampled edges against ground truth on each shard
+    for d in range(8):
+        blk = blocks[0]  # hop-1 block: targets = seeds
+        local = np.asarray(blk.nbr_local)[d]
+        m = np.asarray(blk.mask)[d]
+        assert int(np.asarray(blk.num_targets)[d]) == B
+        for b in range(B):
+            tgt = seeds[d, b]
+            row = set(
+                small_graph.indices[
+                    small_graph.indptr[tgt]: small_graph.indptr[tgt + 1]
+                ].tolist()
+            )
+            deg = len(row)
+            got = [n_id[d, local[b, j]] for j in range(local.shape[1])
+                   if m[b, j]]
+            assert len(got) == min(deg, 4) or deg > 4  # cap overflow only
+            for x in got:
+                assert x in row
+
+
+def test_dist_sampler_counts_match_single(small_graph):
+    """Per-seed neighbor counts equal min(deg, k) when caps are exact."""
+    mesh = make_mesh(("data",))
+    s = DistGraphSampler(small_graph, mesh, sizes=[5],
+                         request_cap_frac=1.0)
+    B = 8
+    seeds = np.tile(np.arange(B)[None], (8, 1))
+    n_id, n_mask, num, blocks = s.sample(seeds, key=3)
+    deg = small_graph.degree
+    counts = np.asarray(blocks[0].mask).sum(axis=2)
+    for d in range(8):
+        np.testing.assert_array_equal(
+            counts[d], np.minimum(deg[:B], 5)
+        )
