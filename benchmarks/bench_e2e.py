@@ -1,0 +1,91 @@
+"""End-to-end GraphSAGE epoch-time benchmark.
+
+Mirrors the reference's e2e table (docs/Introduction_en.md:142-158:
+ogbn-products 3-layer GraphSAGE, quiver 11.1s -> 3.25s on 1 -> 4 GPUs vs
+PyG 36.5s).  Synthetic products-scale graph; single chip here, the DP
+variant scales with the mesh (see examples/papers100M_dist.py).
+"""
+
+import argparse
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nodes", type=int, default=2_449_029)
+    ap.add_argument("--edges", type=int, default=123_718_280)
+    ap.add_argument("--dim", type=int, default=100)
+    ap.add_argument("--classes", type=int, default=47)
+    ap.add_argument("--train-frac", type=float, default=0.08)
+    ap.add_argument("--batch-size", type=int, default=1024)
+    ap.add_argument("--cache", default="800M")
+    ap.add_argument("--epochs", type=int, default=2)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from bench import build_graph
+    from quiver_tpu import CSRTopo, Feature, GraphSageSampler
+    from quiver_tpu.models import GraphSAGE
+    from quiver_tpu.parallel import TrainState, make_train_step, Prefetcher
+
+    rng = np.random.default_rng(0)
+    indptr, indices = build_graph(args.nodes, args.edges)
+    topo = CSRTopo(indptr=indptr, indices=indices)
+    feat = rng.normal(size=(args.nodes, args.dim)).astype(np.float32)
+    labels = rng.integers(0, args.classes, args.nodes)
+    train_idx = rng.choice(args.nodes,
+                           int(args.nodes * args.train_frac), replace=False)
+
+    sampler = GraphSageSampler(topo, [15, 10, 5])
+    feature = Feature(device_cache_size=args.cache,
+                      csr_topo=topo).from_cpu_tensor(feat)
+    model = GraphSAGE(hidden=256, out_dim=args.classes, num_layers=3)
+    tx = optax.adam(3e-3)
+    B = args.batch_size
+
+    b0 = sampler.sample(train_idx[:B])
+    x0 = feature[np.asarray(b0.n_id)]
+    params = model.init(jax.random.PRNGKey(0), x0, b0.layers)
+    state = TrainState.create(params, tx)
+    step = make_train_step(
+        lambda p, x, blocks, train=False, rngs=None: model.apply(
+            p, x, blocks, train=train, rngs=rngs
+        ), tx,
+    )
+
+    n_batches = len(train_idx) // B
+    ones = jnp.ones((B,), bool)
+
+    def make_batch(i):
+        seeds = train_idx[i * B: (i + 1) * B]
+        batch = sampler.sample(seeds, key=jax.random.PRNGKey(i))
+        x = feature[np.asarray(batch.n_id)]
+        return batch, x, jnp.asarray(labels[seeds])
+
+    for epoch in range(args.epochs):
+        rng.shuffle(train_idx)
+        t0 = time.perf_counter()
+        loss = None
+        for batch, x, lab in Prefetcher(range(n_batches), make_batch,
+                                        depth=2):
+            state, loss = step(state, x, batch.layers, lab, ones,
+                               jax.random.PRNGKey(1))
+        jax.block_until_ready(loss)
+        dt = time.perf_counter() - t0
+        print(f"epoch {epoch}: {dt:.2f}s "
+              f"({n_batches} batches, {dt / n_batches * 1e3:.1f} ms/batch) "
+              f"loss={float(loss):.3f}")
+    print("reference bar: quiver 1-GPU 11.1s/epoch, 4-GPU 3.25s "
+          "(products, real data)")
+
+
+if __name__ == "__main__":
+    main()

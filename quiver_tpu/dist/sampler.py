@@ -184,7 +184,7 @@ class DistGraphSampler:
                     mask=b.mask[None],
                     num_targets=b.num_targets[None],
                 )
-                for b in blocks
+                for b in blocks[::-1]  # outermost-first, like SampledBatch
             )
             return (frontier[None], fmask[None],
                     fmask.sum().astype(jnp.int32)[None], blocks_out)

@@ -1,1 +1,2 @@
 from .train import TrainState, make_train_step, shard_batch, replicate
+from .prefetch import Prefetcher, AsyncNeighborSampler

@@ -36,7 +36,7 @@ def test_dist_sampler_edges_real(small_graph):
     np.testing.assert_array_equal(n_id[:, :B], seeds)
     # spot-check sampled edges against ground truth on each shard
     for d in range(8):
-        blk = blocks[0]  # hop-1 block: targets = seeds
+        blk = blocks[-1]  # innermost hop: targets = seeds
         local = np.asarray(blk.nbr_local)[d]
         m = np.asarray(blk.mask)[d]
         assert int(np.asarray(blk.num_targets)[d]) == B
