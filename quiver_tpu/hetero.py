@@ -41,14 +41,15 @@ class HeteroLayerBlock:
     relation: Relation = struct.field(pytree_node=False)
 
 
-class HeteroSampledBatch(NamedTuple):
+@struct.dataclass
+class HeteroSampledBatch:
     # per node type: padded frontier ids + validity
     n_id: Dict[str, jax.Array]
     n_id_mask: Dict[str, jax.Array]
-    batch_size: int
-    seed_type: str
     # layers[l] = list of HeteroLayerBlock for hop l, OUTERMOST first
     layers: Tuple[Tuple[HeteroLayerBlock, ...], ...]
+    batch_size: int = struct.field(pytree_node=False)
+    seed_type: str = struct.field(pytree_node=False)
 
 
 class HeteroCSRTopo:
