@@ -39,6 +39,7 @@ from .hetero import (
     HeteroLayerBlock,
 )
 from .neighbour_num import generate_neighbour_num
+from . import multiprocessing  # registers mp reducers (parity: P10)
 from .serving import (
     RequestBatcher,
     HybridSampler,

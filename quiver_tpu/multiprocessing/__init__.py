@@ -1,0 +1,14 @@
+"""Multiprocessing integration — ``import quiver_tpu.multiprocessing``
+registers reducers so samplers/features can cross ``mp.spawn`` boundaries.
+
+Reference parity: ``srcs/python/quiver/multiprocessing/reductions.py:1-34``
+(ForkingPickler reducers over cudaIpc handles).  Single-controller JAX has
+no cudaIpc: device arrays are materialized to host on pickle and re-placed
+lazily in the child (first use), which is exactly the reference's
+``lazy_from_ipc_handle`` flow minus the handle plumbing.  Worth noting:
+within ONE process a thread pool (``quiver_tpu.mixed``/``serving``) needs
+none of this — processes are only for user scripts that insist on
+``mp.spawn`` symmetry with their torch code.
+"""
+
+from . import reductions  # noqa: F401  (import side effect = registration)

@@ -1,0 +1,54 @@
+"""ForkingPickler reducers for quiver_tpu objects.
+
+Parity: ``srcs/python/quiver/multiprocessing/reductions.py``.  The packed
+form is host-side numpy (device arrays are fetched); children rebuild
+lazily on first use so spawn cost is one host copy, not a device sync
+storm.
+"""
+
+from __future__ import annotations
+
+from multiprocessing.reduction import ForkingPickler
+
+import numpy as np
+
+from ..feature import Feature
+from ..sampler import GraphSageSampler
+
+
+def _host(tree):
+    import jax
+
+    def conv(x):
+        # fetch device arrays; leave numpy (incl. memmap cold tiers) alone
+        if isinstance(x, jax.Array):
+            return np.asarray(x)
+        return x
+
+    return jax.tree_util.tree_map(conv, tree)
+
+
+def rebuild_feature(handle):
+    return Feature.lazy_from_ipc_handle(handle)
+
+
+def reduce_feature(f: Feature):
+    handle = _host(f.share_ipc())
+    return (rebuild_feature, (handle,))
+
+
+def rebuild_sampler(csr_topo, sizes, mode):
+    return GraphSageSampler(csr_topo, sizes, mode=mode)
+
+
+def reduce_sampler(s: GraphSageSampler):
+    csr_topo, sizes, mode = s.share_ipc()
+    return (rebuild_sampler, (csr_topo, sizes, mode))
+
+
+def init_reductions():
+    ForkingPickler.register(Feature, reduce_feature)
+    ForkingPickler.register(GraphSageSampler, reduce_sampler)
+
+
+init_reductions()

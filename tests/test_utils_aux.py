@@ -81,3 +81,29 @@ def test_mesh_topo():
     cliques = t.p2p_clique()
     assert sum(len(v) for v in cliques.values()) == 8  # 8 virtual devices
     assert "Clique" in t.info
+
+
+def test_mp_reductions_roundtrip(small_graph, rng):
+    """ForkingPickler pack/unpack of Feature and sampler (parity: P10)."""
+    import io
+    import pickle
+    from multiprocessing.reduction import ForkingPickler
+
+    import quiver_tpu  # noqa: F401  (registers reducers)
+    from quiver_tpu import Feature, GraphSageSampler
+
+    n = small_graph.node_count
+    feat = rng.normal(size=(n, 8)).astype(np.float32)
+    f = Feature(device_cache_size="1G").from_cpu_tensor(feat)
+    buf = io.BytesIO()
+    ForkingPickler(buf).dump(f)
+    g = pickle.loads(buf.getvalue())
+    ids = rng.integers(0, n, 16)
+    np.testing.assert_allclose(np.asarray(g[ids]), feat[ids], rtol=1e-6)
+
+    s = GraphSageSampler(small_graph, [4, 3])
+    buf = io.BytesIO()
+    ForkingPickler(buf).dump(s)
+    s2 = pickle.loads(buf.getvalue())
+    b = s2.sample(np.arange(8))
+    assert b.batch_size == 8
