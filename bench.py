@@ -25,20 +25,9 @@ def log(*a):
 
 def build_graph(n_nodes, n_edges, seed=0):
     """Power-law-ish synthetic graph at ogbn-products scale."""
-    rng = np.random.default_rng(seed)
-    raw = rng.lognormal(mean=3.0, sigma=1.0, size=n_nodes)
-    deg = np.maximum(raw / raw.sum() * n_edges, 1).astype(np.int64)
-    # trim to exact edge count
-    excess = int(deg.sum() - n_edges)
-    if excess > 0:
-        idx = rng.choice(n_nodes, size=excess, p=deg / deg.sum())
-        np.subtract.at(deg, idx, 1)
-        deg = np.maximum(deg, 0)
-    indptr = np.zeros(n_nodes + 1, dtype=np.int64)
-    np.cumsum(deg, out=indptr[1:])
-    e = int(indptr[-1])
-    indices = rng.integers(0, n_nodes, size=e, dtype=np.int32)
-    return indptr, indices
+    from quiver_tpu.utils.synthetic import synthetic_csr
+
+    return synthetic_csr(n_nodes, n_edges, seed)
 
 
 def bench_sampling(indptr, indices, batch_size, sizes, iters, warmup=3):
