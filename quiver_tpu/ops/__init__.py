@@ -1,3 +1,4 @@
-from .sample import sample_neighbors, SampleOut, to_ragged
+from .sample import (sample_neighbors, sample_neighbors_weighted,
+                     row_cumsum_weights, SampleOut, to_ragged)
 from .reindex import reindex, ReindexOut
 from .prob import cal_neighbor_prob, sample_prob

@@ -106,6 +106,83 @@ def sample_neighbors(
     return SampleOut(nbrs=nbrs, mask=mask, counts=counts)
 
 
+@functools.partial(jax.jit, static_argnames=("k", "bits"))
+def sample_neighbors_weighted(
+    indptr: jax.Array,
+    indices: jax.Array,
+    cum_weights: jax.Array,
+    seeds: jax.Array,
+    k: int,
+    key: jax.Array,
+    seed_mask: Optional[jax.Array] = None,
+    bits: int = 24,
+) -> SampleOut:
+    """Weight-proportional neighbor sampling (WITH replacement).
+
+    Parity: the reference's ``weight_sample`` path
+    (``cuda_random.cu.hpp:149-221`` — thrust discrete-distribution draws
+    per row).  TPU formulation: ``cum_weights[e]`` is the inclusive
+    per-row cumulative weight (host-precomputed once via
+    :func:`row_cumsum_weights`); each draw inverts the row CDF with a
+    fixed-depth binary search (``bits`` iterations of clipped gathers —
+    data-independent control flow, so XLA unrolls it).
+
+    ``deg <= k`` rows return all neighbors once (mask semantics identical
+    to :func:`sample_neighbors`).
+    """
+    seeds = seeds.astype(jnp.int32)
+    B = seeds.shape[0]
+    start = jnp.take(indptr, seeds, mode="clip")
+    end = jnp.take(indptr, seeds + 1, mode="clip")
+    deg = end - start
+    if seed_mask is not None:
+        deg = jnp.where(seed_mask, deg, 0)
+    counts = jnp.minimum(deg, k).astype(jnp.int32)
+    j = jnp.arange(k, dtype=jnp.int32)[None, :]
+    mask = j < counts[:, None]
+
+    # total row weight = cum_weights[end-1] (inclusive cumsum per row)
+    total = jnp.where(
+        deg > 0,
+        jnp.take(cum_weights, jnp.maximum(end - 1, 0), mode="clip"),
+        0.0,
+    )
+    u = jax.random.uniform(key, (B, k)) * total[:, None]
+
+    # binary search for first position p in [start, end) with cw[p] > u
+    lo = jnp.broadcast_to(start[:, None], (B, k))
+    hi = jnp.broadcast_to(end[:, None], (B, k))
+
+    def step(_, lohi):
+        lo, hi = lohi
+        mid = (lo + hi) // 2
+        cw = jnp.take(cum_weights, mid, mode="clip")
+        gt = cw > u
+        return jnp.where(gt, lo, mid + 1), jnp.where(gt, mid, hi)
+
+    lo, hi = jax.lax.fori_loop(0, bits, step, (lo, hi))
+    pos = jnp.clip(lo, start[:, None], jnp.maximum(end[:, None] - 1, 0))
+    # deg <= k: take all neighbors once instead of resampling
+    pos = jnp.where(deg[:, None] <= k, start[:, None] + j, pos)
+    nbrs = jnp.take(indices, jnp.where(mask, pos, 0), mode="clip")
+    nbrs = jnp.where(mask, nbrs, jnp.int32(-1))
+    return SampleOut(nbrs=nbrs, mask=mask, counts=counts)
+
+
+def row_cumsum_weights(indptr, weights):
+    """Host-side per-row inclusive cumulative weights for
+    :func:`sample_neighbors_weighted`.  One pass at graph-build time."""
+    import numpy as np
+
+    indptr = np.asarray(indptr)
+    w = np.asarray(weights, dtype=np.float32)
+    cw = np.cumsum(w)
+    # subtract the cumsum value just before each row start
+    prev = np.concatenate([[0.0], cw])[indptr[:-1]]
+    out = cw - np.repeat(prev, np.diff(indptr))
+    return out.astype(np.float32)
+
+
 def to_ragged(out: SampleOut) -> Tuple[jax.Array, jax.Array]:
     """Dense ``[B, k]`` -> reference 2-tensor form (flat neighbors, counts).
 
