@@ -22,6 +22,7 @@ Public API parity map (reference ``srcs/python/quiver/__init__.py:1-21``):
 from .utils.topology import CSRTopo, coo_to_csr, parse_size, reindex_feature
 from .utils.mesh import MeshTopo, make_mesh
 from .sampler import GraphSageSampler, SampledBatch, LayerBlock
+from .loader import SeedLoader
 from .mixed import MixedGraphSageSampler, SampleJob
 from .feature import Feature, DeviceConfig
 from .dist.feature import DistFeature, PartitionInfo
@@ -52,7 +53,7 @@ __version__ = "0.1.0"
 __all__ = [
     "CSRTopo", "coo_to_csr", "parse_size", "reindex_feature",
     "MeshTopo", "make_mesh",
-    "GraphSageSampler", "SampledBatch", "LayerBlock",
+    "GraphSageSampler", "SampledBatch", "LayerBlock", "SeedLoader",
     "MixedGraphSageSampler", "SampleJob",
     "HeteroCSRTopo", "HeteroGraphSageSampler", "HeteroSampledBatch",
     "HeteroLayerBlock",

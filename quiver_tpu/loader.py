@@ -1,0 +1,88 @@
+"""Seed/batch loader — the training-loop front end.
+
+The reference's examples drive sampling with a torch ``DataLoader`` over
+the train-id tensor (``examples/pyg/ogbn_products_sage_quiver.py:138``:
+``DataLoader(train_idx, batch_size=1024, shuffle=True)``) and call
+sampler/feature per batch.  ``SeedLoader`` packages that loop TPU-style:
+epoch shuffling, fixed batch shapes (last partial batch padded + masked,
+never a recompile), and host-side prefetch of sample+gather behind the
+accelerator (``parallel.Prefetcher``).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterator, Optional
+
+import numpy as np
+
+from .parallel.prefetch import Prefetcher
+
+__all__ = ["SeedLoader"]
+
+
+class SeedLoader:
+    """Iterate (SampledBatch, features, labels, label_mask) epochs.
+
+    Args:
+      train_idx: ``[T]`` seed node ids.
+      sampler: :class:`GraphSageSampler` (or hetero variant).
+      feature: :class:`Feature` (or any ``__getitem__`` over node ids).
+      labels: optional ``[N]`` label array.
+      batch_size: fixed batch size; the last partial batch is padded with
+        repeats and masked via ``label_mask`` (static shapes, no recompile).
+      shuffle: epoch shuffling.
+      prefetch: host-side pipeline depth (0 disables).
+    """
+
+    def __init__(self, train_idx, sampler, feature, labels=None,
+                 batch_size: int = 1024, shuffle: bool = True,
+                 drop_last: bool = False, prefetch: int = 2, seed: int = 0):
+        self.train_idx = np.asarray(train_idx)
+        self.sampler = sampler
+        self.feature = feature
+        self.labels = None if labels is None else np.asarray(labels)
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self.prefetch = prefetch
+        self._rng = np.random.default_rng(seed)
+        self._epoch = 0
+
+    def __len__(self):
+        n = len(self.train_idx)
+        return n // self.batch_size if self.drop_last else (
+            (n + self.batch_size - 1) // self.batch_size
+        )
+
+    def _make(self, i: int):
+        import jax
+        import jax.numpy as jnp
+
+        B = self.batch_size
+        seeds = self.train_idx[i * B: (i + 1) * B]
+        valid = len(seeds)
+        if valid < B:  # pad to the fixed shape, mask the tail
+            seeds = np.concatenate(
+                [seeds, np.repeat(seeds[:1] if valid else [0], B - valid)]
+            )
+        key = jax.random.PRNGKey(
+            (self._epoch * 1_000_003 + i) & 0x7FFFFFFF
+        )
+        batch = self.sampler.sample(seeds, key=key)
+        x = self.feature[np.asarray(batch.n_id)]
+        mask = jnp.arange(B) < valid
+        if self.labels is not None:
+            labels = jnp.asarray(self.labels[seeds])
+        else:
+            labels = jnp.zeros((B,), jnp.int32)
+        return batch, x, labels, mask
+
+    def __iter__(self) -> Iterator:
+        if self.shuffle:
+            self._rng.shuffle(self.train_idx)
+        self._epoch += 1
+        n = len(self)
+        if self.prefetch > 0:
+            return iter(Prefetcher(range(n), self._make,
+                                   depth=self.prefetch))
+        return (self._make(i) for i in range(n))

@@ -1,0 +1,43 @@
+"""SeedLoader tests: fixed shapes, masked tail, epoch shuffling."""
+
+import numpy as np
+import jax
+import pytest
+
+from quiver_tpu import Feature, GraphSageSampler
+from quiver_tpu.loader import SeedLoader
+
+
+def test_loader_shapes_and_tail(small_graph, rng):
+    n = small_graph.node_count
+    feat = rng.normal(size=(n, 8)).astype(np.float32)
+    feature = Feature(device_cache_size="1G").from_cpu_tensor(feat)
+    sampler = GraphSageSampler(small_graph, [4, 3])
+    train_idx = np.arange(50)
+    loader = SeedLoader(train_idx, sampler, feature, labels=np.zeros(n),
+                        batch_size=16, shuffle=False, prefetch=2)
+    assert len(loader) == 4  # 50/16 -> 3 full + 1 padded
+    batches = list(loader)
+    assert len(batches) == 4
+    for i, (batch, x, labels, mask) in enumerate(batches):
+        assert batch.batch_size == 16
+        assert x.shape[0] == batch.n_id.shape[0]
+        if i < 3:
+            assert bool(np.asarray(mask).all())
+        else:
+            assert int(np.asarray(mask).sum()) == 50 - 48
+
+
+def test_loader_covers_all_seeds(small_graph, rng):
+    n = small_graph.node_count
+    feat = rng.normal(size=(n, 4)).astype(np.float32)
+    feature = Feature(device_cache_size="1G").from_cpu_tensor(feat)
+    sampler = GraphSageSampler(small_graph, [3])
+    train_idx = np.arange(40)
+    loader = SeedLoader(train_idx, sampler, feature, batch_size=8,
+                        shuffle=True, prefetch=0, seed=1)
+    seen = []
+    for batch, x, labels, mask in loader:
+        seeds = np.asarray(batch.n_id)[:8][np.asarray(mask)]
+        seen.extend(seeds.tolist())
+    assert sorted(seen) == list(range(40))
