@@ -1,0 +1,66 @@
+"""Pallas TPU kernel: fused element gather (row-block + lane select).
+
+The XLA formulation of the fast scalar gather (``ops/fastgather.py``)
+materializes the gathered ``[M, 128]`` row blocks to HBM before the
+one-hot lane reduction — 2x the necessary HBM traffic.  This kernel fuses
+the two: each grid program loads its slice of indices (scalar prefetch),
+row-gathers the covering 128-lane blocks HBM->VMEM via the XLA-level
+prelude (done by the caller, streamed through the grid), and reduces to
+one lane on the VPU before anything returns to HBM.
+
+Layout: the caller supplies ``rows [M, 128]`` produced by ``jnp.take`` —
+under jit the producer fuses INTO this pallas_call's input stream (XLA
+pipelines HBM->VMEM block loads), so the intermediate never lands in HBM
+as a whole.  The kernel itself is just the masked lane reduction, which is
+exactly the part XLA's gather emitter refuses to fuse.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["lane_select"]
+
+BLK = 1024  # indices per grid program
+
+
+def _kernel(lane_ref, rows_ref, out_ref):
+    lanes = lane_ref[:]                       # [BLK, 1] int32
+    rows = rows_ref[:]                        # [BLK, 128]
+    iota = jax.lax.broadcasted_iota(jnp.int32, rows.shape, 1)
+    onehot = iota == lanes
+    out_ref[:] = jnp.sum(
+        jnp.where(onehot, rows, 0), axis=1, keepdims=True
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def lane_select(rows: jax.Array, lanes: jax.Array,
+                interpret: bool = False) -> jax.Array:
+    """``out[i] = rows[i, lanes[i]]`` — fused VPU lane reduction.
+
+    ``rows``: [M, 128]; ``lanes``: [M] int32.  M must be a multiple of
+    BLK (pad + slice at the call site).
+    """
+    m = rows.shape[0]
+    assert m % BLK == 0, m
+    out = pl.pallas_call(
+        _kernel,
+        grid=(m // BLK,),
+        in_specs=[
+            pl.BlockSpec((BLK, 1), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((BLK, 128), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((BLK, 1), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((m, 1), rows.dtype),
+        interpret=interpret,
+    )(lanes.reshape(m, 1).astype(jnp.int32), rows)
+    return out.reshape(m)

@@ -37,3 +37,13 @@ def test_pallas_gather_rows_interpret(rng):
     np.testing.assert_allclose(
         np.asarray(out), np.asarray(table)[np.asarray(idx)], rtol=1e-7
     )
+
+
+def test_pallas_lane_select_interpret(rng):
+    from quiver_tpu.ops.pallas.element_gather_kernel import lane_select, BLK
+
+    rows = jnp.asarray(rng.integers(0, 100, (BLK * 2, 128), dtype=np.int32))
+    lanes = jnp.asarray(rng.integers(0, 128, BLK * 2, dtype=np.int32))
+    out = lane_select(rows, lanes, interpret=True)
+    expect = np.asarray(rows)[np.arange(BLK * 2), np.asarray(lanes)]
+    np.testing.assert_array_equal(np.asarray(out), expect)
