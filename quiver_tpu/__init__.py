@@ -23,6 +23,7 @@ from .utils.topology import CSRTopo, coo_to_csr, parse_size, reindex_feature
 from .utils.mesh import MeshTopo, make_mesh
 from .sampler import GraphSageSampler, SampledBatch, LayerBlock
 from .loader import SeedLoader
+from .pipeline import make_fused_train_step, make_fused_eval_fn
 from .mixed import MixedGraphSageSampler, SampleJob
 from .feature import Feature, DeviceConfig
 from .dist.feature import DistFeature, PartitionInfo
@@ -53,7 +54,7 @@ __version__ = "0.1.0"
 __all__ = [
     "CSRTopo", "coo_to_csr", "parse_size", "reindex_feature",
     "MeshTopo", "make_mesh",
-    "GraphSageSampler", "SampledBatch", "LayerBlock", "SeedLoader",
+    "GraphSageSampler", "SampledBatch", "LayerBlock", "SeedLoader", "make_fused_train_step", "make_fused_eval_fn",
     "MixedGraphSageSampler", "SampleJob",
     "HeteroCSRTopo", "HeteroGraphSageSampler", "HeteroSampledBatch",
     "HeteroLayerBlock",

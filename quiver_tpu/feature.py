@@ -203,15 +203,25 @@ class Feature:
         XLA emit the cross-chip collective); cold rows are gathered on host
         and shipped once per batch, then merged on device.  Parity:
         ``feature.py:296-333`` + ``shard_tensor.py:154-180``.
+
+        Fully-cached features take a pure-device path: jax-array ids never
+        round-trip through the host (the reference pays a cudaMemcpy here
+        only when ids arrive on CPU; same idea).
         """
+        import jax
         import jax.numpy as jnp
 
         self.lazy_init_from_ipc_handle()
+        if self.cache_count >= self.node_count:
+            if isinstance(node_idx, jax.Array):
+                return self.lookup_device(node_idx)
+            idx = np.asarray(node_idx)
+            if self.feature_order is not None:
+                idx = self.feature_order[idx]
+            return jnp.take(self.hot, jnp.asarray(idx), axis=0)
         idx = np.asarray(node_idx)
         if self.feature_order is not None:
             idx = self.feature_order[idx]
-        if self.cache_count >= self.node_count:
-            return jnp.take(self.hot, jnp.asarray(idx), axis=0)
         if self.cache_count == 0:
             return jnp.asarray(np.ascontiguousarray(self.cold[idx]))
 
@@ -225,12 +235,19 @@ class Feature:
         return jnp.where(jnp.asarray(hot_mask)[:, None], hot_part, cold_part)
 
     def lookup_device(self, idx):
-        """Pure-device gather for jit pipelines (requires full HBM cache)."""
+        """Pure-device gather for jit pipelines (requires full HBM cache).
+        Applies ``feature_order`` on device; safe to call under jit."""
         import jax.numpy as jnp
 
         assert self.cache_count >= self.node_count, (
             "lookup_device needs a fully HBM-resident feature"
         )
+        if self.feature_order is not None:
+            if getattr(self, "_order_dev", None) is None:
+                self._order_dev = jnp.asarray(
+                    self.feature_order.astype(np.int32)
+                )
+            idx = jnp.take(self._order_dev, idx, mode="clip")
         return jnp.take(self.hot, idx, axis=0)
 
     # ------------------------------------------------------------------

@@ -1,0 +1,94 @@
+"""Fully-fused training pipeline: sample + gather + forward/backward in
+ONE compiled program.
+
+The reference's hot loop crosses the host every batch: python drives
+sampler kernels, then a feature gather, then the torch step
+(``examples/pyg/ogbn_products_sage_quiver.py:138-147``).  On TPU the whole
+chain is expressible as a single jit — seeds in, (state, loss) out — so
+steady-state training has zero host round-trips and XLA overlaps sampling
+gathers with the previous layer's compute.  Requires the feature hot tier
+to cover the graph (HBM-resident or ici-sharded); budgeted hot/cold setups
+fall back to the two-stage loop (``SeedLoader``).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from .feature import Feature
+from .sampler import GraphSageSampler, _sample_pipeline_nodedup, SampledBatch
+from .parallel.train import TrainState
+
+__all__ = ["make_fused_train_step", "make_fused_eval_fn"]
+
+
+def _check(feature: Feature):
+    assert feature.cache_count >= feature.node_count, (
+        "fused pipeline needs the feature fully HBM-resident "
+        f"(cache {feature.cache_count} < nodes {feature.node_count}); "
+        "use SeedLoader for budgeted hot/cold configs"
+    )
+
+
+def make_fused_train_step(sampler: GraphSageSampler, feature: Feature,
+                          apply_fn: Callable,
+                          tx: optax.GradientTransformation,
+                          loss_fn: Optional[Callable] = None):
+    """Build ``(state, seeds, labels, label_mask, key) -> (state, loss)``
+    with sampling and feature gather inside the jit."""
+    _check(feature)
+    indptr, indices = sampler.csr_topo.to_device(sampler.device)
+    sizes = tuple(sampler.sizes)
+    gm = sampler.gather_mode
+
+    if loss_fn is None:
+        def loss_fn(logits, labels, mask):
+            ls = optax.softmax_cross_entropy_with_integer_labels(
+                logits, labels
+            )
+            m = mask.astype(ls.dtype)
+            return (ls * m).sum() / jnp.maximum(m.sum(), 1.0)
+
+    @jax.jit
+    def step(state: TrainState, seeds, labels, label_mask, key):
+        ks, kd = jax.random.split(key)
+        n_id, n_mask, num, blocks = _sample_pipeline_nodedup(
+            indptr, indices, seeds, ks, sizes, gather_mode=gm
+        )
+        x = feature.lookup_device(n_id)
+
+        def compute(params):
+            logits = apply_fn(params, x, blocks, train=True,
+                              rngs={"dropout": kd})
+            return loss_fn(logits, labels, label_mask)
+
+        loss, grads = jax.value_and_grad(compute)(state.params)
+        updates, opt_state = state.tx.update(grads, state.opt_state,
+                                             state.params)
+        params = optax.apply_updates(state.params, updates)
+        return TrainState(params, opt_state, state.tx), loss
+
+    return step
+
+
+def make_fused_eval_fn(sampler: GraphSageSampler, feature: Feature,
+                       apply_fn: Callable):
+    """``(params, seeds, key) -> logits`` with sampling inside the jit."""
+    _check(feature)
+    indptr, indices = sampler.csr_topo.to_device(sampler.device)
+    sizes = tuple(sampler.sizes)
+    gm = sampler.gather_mode
+
+    @jax.jit
+    def eval_fn(params, seeds, key):
+        n_id, n_mask, num, blocks = _sample_pipeline_nodedup(
+            indptr, indices, seeds, key, sizes, gather_mode=gm
+        )
+        x = feature.lookup_device(n_id)
+        return apply_fn(params, x, blocks, train=False, rngs=None)
+
+    return eval_fn

@@ -1,0 +1,70 @@
+"""Fused sample+gather+train pipeline tests."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import optax
+import pytest
+
+from quiver_tpu import Feature, GraphSageSampler
+from quiver_tpu.models import GraphSAGE
+from quiver_tpu.parallel import TrainState
+from quiver_tpu.pipeline import make_fused_train_step, make_fused_eval_fn
+from quiver_tpu.utils.synthetic import community_graph
+
+
+@pytest.fixture(scope="module")
+def setup():
+    topo, feat, comm = community_graph(400, 4, seed=3)
+    feature = Feature(device_cache_size="1G").from_cpu_tensor(feat)
+    sampler = GraphSageSampler(topo, [5, 5])
+    model = GraphSAGE(hidden=32, out_dim=4, num_layers=2, dropout=0.0)
+    return topo, feature, sampler, model, comm
+
+
+def test_fused_step_learns(setup):
+    topo, feature, sampler, model, comm = setup
+    tx = optax.adam(1e-2)
+    rng = np.random.default_rng(0)
+    B = 32
+    seeds0 = jnp.asarray(rng.integers(0, topo.node_count, B), jnp.int32)
+    b0 = sampler.sample(np.asarray(seeds0))
+    params = model.init(jax.random.PRNGKey(0), feature[b0.n_id], b0.layers)
+    state = TrainState.create(params, tx)
+    step = make_fused_train_step(
+        sampler, feature,
+        lambda p, x, blocks, train=False, rngs=None: model.apply(
+            p, x, blocks, train=train, rngs=rngs
+        ), tx,
+    )
+    losses = []
+    ones = jnp.ones((B,), bool)
+    for i in range(25):
+        seeds = jnp.asarray(rng.integers(0, topo.node_count, B), jnp.int32)
+        labels = jnp.asarray(np.asarray(comm)[np.asarray(seeds)])
+        state, loss = step(state, seeds, labels, ones,
+                           jax.random.PRNGKey(i))
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.7, losses[::5]
+
+    ev = make_fused_eval_fn(
+        sampler, feature,
+        lambda p, x, blocks, train=False, rngs=None: model.apply(
+            p, x, blocks, train=train, rngs=rngs
+        ),
+    )
+    seeds = jnp.asarray(rng.integers(0, topo.node_count, B), jnp.int32)
+    logits = ev(state.params, seeds, jax.random.PRNGKey(99))
+    pred = np.asarray(jnp.argmax(logits[:B], -1))
+    acc = (pred == np.asarray(comm)[np.asarray(seeds)]).mean()
+    assert acc > 0.5, acc
+
+
+def test_fused_requires_full_cache(setup):
+    topo, _, sampler, model, _ = setup
+    rng = np.random.default_rng(0)
+    feat = rng.normal(size=(topo.node_count, 4)).astype(np.float32)
+    partial = Feature(device_cache_size=4 * 4 * 10).from_cpu_tensor(feat)
+    with pytest.raises(AssertionError):
+        make_fused_train_step(sampler, partial, lambda *a, **k: None,
+                              optax.adam(1e-3))
