@@ -91,3 +91,45 @@ def test_end_to_end_serving(small_graph, rng):
     assert stats["count"] == n_req
     assert stats["p99_latency_ms"] >= stats["p50_latency_ms"]
     assert stats["throughput_rps"] > 0
+
+
+def test_preparation_mode_duplicates(small_graph):
+    q = queue.Queue()
+    rb = RequestBatcher([q], mode="Preparation").start()
+    q.put(ServingRequest(ids=np.array([1, 2]), client=0, seq=0))
+    time.sleep(0.2)
+    rb.stop()
+    assert isinstance(rb.cpu_batched_queue.get_nowait(), ServingRequest)
+    assert isinstance(rb.device_batched_queue.get_nowait(), ServingRequest)
+
+
+def test_server_lane_survives_errors(small_graph, rng):
+    """A poisoned request yields an error result; later requests still
+    serve (the reference's loops would have died — serving.py:198)."""
+    n = small_graph.node_count
+    feat = rng.normal(size=(n, 4)).astype(np.float32)
+    feature = Feature(device_cache_size="1G").from_cpu_tensor(feat)
+    sampler = GraphSageSampler(small_graph, [3])
+    model = GraphSAGE(hidden=8, out_dim=2, num_layers=1, dropout=0.0)
+    b0 = sampler.sample(np.arange(8, dtype=np.int64))
+    params = model.init(jax.random.PRNGKey(0), feature[np.asarray(b0.n_id)],
+                        b0.layers)
+
+    calls = {"n": 0}
+
+    def apply_fn(p, x, blocks):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("boom")
+        return model.apply(p, x, blocks)
+
+    dq = queue.Queue()
+    server = InferenceServer(sampler, feature, apply_fn, params, dq).start()
+    dq.put(ServingRequest(ids=np.array([1, 2, 3]), client=0, seq=0))
+    dq.put(ServingRequest(ids=np.array([4, 5]), client=0, seq=1))
+    r0 = server.result_queue.get(timeout=60)
+    r1 = server.result_queue.get(timeout=60)
+    server.stop()
+    outs = {r0[0].seq: r0[1], r1[0].seq: r1[1]}
+    assert isinstance(outs[0], RuntimeError)
+    assert outs[1].shape == (2, 2)
