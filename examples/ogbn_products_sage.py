@@ -101,7 +101,22 @@ def main():
         tx,
     )
 
+    # fully-cached features unlock the fused pipeline: sample + gather +
+    # train in ONE jit, no host work in the steady-state loop
+    fused = None
+    if feature.cache_count >= feature.node_count:
+        from quiver_tpu.pipeline import make_fused_train_step
+
+        fused = make_fused_train_step(
+            sampler, feature,
+            lambda p, x, blocks, train=False, rngs=None: model.apply(
+                p, x, blocks, train=train, rngs=rngs
+            ), tx,
+        )
+        print("using fused on-device pipeline")
+
     rng = np.random.default_rng(1)
+    ones = jnp.ones((B,), bool)
     for epoch in range(args.epochs):
         order = rng.permutation(len(train_idx))
         t0 = time.perf_counter()
@@ -109,13 +124,17 @@ def main():
         n_batches = len(train_idx) // B
         for i in range(n_batches):
             seeds = train_idx[order[i * B: (i + 1) * B]]
-            batch = sampler.sample(seeds, key=jax.random.PRNGKey(
-                epoch * n_batches + i))
-            x = feature[np.asarray(batch.n_id)]
-            lab = jnp.asarray(labels[seeds])
-            state, loss = step(state, x, batch.layers, lab,
-                               jnp.ones((B,), bool),
-                               jax.random.PRNGKey(10_000 + i))
+            if fused is not None:
+                state, loss = fused(state, jnp.asarray(seeds, jnp.int32),
+                                    jnp.asarray(labels[seeds]), ones,
+                                    jax.random.PRNGKey(10_000 + i))
+            else:
+                batch = sampler.sample(seeds, key=jax.random.PRNGKey(
+                    epoch * n_batches + i))
+                x = feature[np.asarray(batch.n_id)]
+                lab = jnp.asarray(labels[seeds])
+                state, loss = step(state, x, batch.layers, lab, ones,
+                                   jax.random.PRNGKey(10_000 + i))
             losses.append(loss)
         jax.block_until_ready(losses[-1])
         dt = time.perf_counter() - t0
