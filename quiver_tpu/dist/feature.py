@@ -72,6 +72,17 @@ class PartitionInfo:
         # assigned at build time per host (see DistFeature.build_shards).
         self.max_local = int(owned_counts.max() + len(rep_ids))
 
+    @classmethod
+    def from_partition_book(cls, book, device=0, host: int = 0,
+                            hosts: Optional[int] = None, replicate=None):
+        """Build from a ``feature_partition_book`` (node -> partition id),
+        the artifact written by :func:`quiver_tpu.quiver_partition_feature`
+        (parity: the loader flow at partition.py:252-283)."""
+        book = np.asarray(book)
+        return cls(device=device, host=host,
+                   hosts=hosts if hosts is not None else int(book.max()) + 1,
+                   global2host=book, replicate=replicate)
+
     def dispatch(self, ids: np.ndarray):
         """Parity helper (``feature.py:510-526``): bucket ids per host.
 

@@ -101,13 +101,16 @@ class Feature:
             return len(self.device_list)
         return jax.local_device_count()
 
-    def from_cpu_tensor(self, tensor) -> "Feature":
+    def from_cpu_tensor(self, tensor, prob=None) -> "Feature":
         """Split ``tensor`` into HBM hot prefix + host cold tail.
 
         Parity: ``feature.py:194-281``.  With ``csr_topo`` set, rows are
         first permuted into degree-descending order (shuffled hot slice) and
         ``feature_order`` records old->new ids; ``csr_topo.feature_order``
-        is set as a side effect, as in the reference.
+        is set as a side effect, as in the reference.  ``prob`` (a per-node
+        access-probability vector, e.g. from ``sample_prob``) overrides the
+        degree heuristic — the reference's papers100M policy
+        (``set_local_order``, feature.py:283).
         """
         import jax
         import jax.numpy as jnp
@@ -119,7 +122,13 @@ class Feature:
         nd = self._n_devices()
         cache_count = min(self._budget_rows(row_bytes, nd), self.node_count)
 
-        if self.csr_topo is not None and cache_count > 0:
+        if prob is not None and cache_count > 0:
+            order = np.argsort(-np.asarray(prob), kind="stable")
+            new_order = np.empty(self.node_count, dtype=np.int64)
+            new_order[order] = np.arange(self.node_count)
+            tensor = tensor[order]
+            self.feature_order = new_order
+        elif self.csr_topo is not None and cache_count > 0:
             ratio = cache_count / self.node_count
             tensor, new_order = reindex_feature(self.csr_topo, tensor, ratio)
             self.feature_order = new_order

@@ -59,12 +59,14 @@ def partition_without_replication(
     order = ids[np.argsort(-total, kind="stable")]
     res: List[List[np.ndarray]] = [[] for _ in range(n_parts)]
     chunks = np.array_split(order, chunk_num)
-    for chunk in chunks:
+    for ci, chunk in enumerate(chunks):
         if len(chunk) == 0:
             continue
         remaining = chunk.copy()
         share = int(np.ceil(len(chunk) / n_parts))
-        for p in range(n_parts):
+        # rotate the starting partition per chunk so small chunks don't
+        # starve the high-numbered partitions
+        for p in [(ci + q) % n_parts for q in range(n_parts)]:
             if len(remaining) == 0:
                 break
             own = probs[p][remaining]

@@ -239,7 +239,10 @@ class GraphSageSampler:
         """
         if self.mode == "CPU":
             return self._sample_cpu(input_nodes)
-        seeds = jnp.asarray(np.asarray(input_nodes), dtype=jnp.int32)
+        if isinstance(input_nodes, jax.Array):  # stay on device
+            seeds = input_nodes.astype(jnp.int32)
+        else:
+            seeds = jnp.asarray(np.asarray(input_nodes), dtype=jnp.int32)
         B = seeds.shape[0]
         if self._jitted is None or self._jitted[0] != B:
             self._jitted = (B, self._build_jit(B))

@@ -96,3 +96,21 @@ def test_dist_feature_parity_getitem(mesh, rng):
     ids = rng.integers(0, n, 16)
     out = np.asarray(df[ids])
     np.testing.assert_allclose(out, full[ids], rtol=1e-6)
+
+
+def test_partition_to_distfeature_roundtrip(mesh, tmp_path, rng):
+    """quiver_partition_feature book -> PartitionInfo -> DistFeature lookup
+    equals the original features (tooling + runtime coherence)."""
+    from quiver_tpu import quiver_partition_feature
+
+    n, d = 160, 4
+    feature = rng.normal(size=(n, d)).astype(np.float32)
+    probs = [rng.uniform(0, 1, n) for _ in range(NHOSTS)]
+    _, _, book = quiver_partition_feature(feature, probs, str(tmp_path))
+    info = PartitionInfo.from_partition_book(book)
+    assert info.hosts == NHOSTS
+    df = DistFeature.from_global_feature(feature, mesh, info)
+    ids = rng.integers(0, n, (NHOSTS, 16)).astype(np.int32)
+    out = np.asarray(df.lookup(ids))
+    for h in range(NHOSTS):
+        np.testing.assert_allclose(out[h], feature[ids[h]], rtol=1e-6)

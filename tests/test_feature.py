@@ -81,3 +81,20 @@ def test_ipc_parity_roundtrip(small_graph, rng):
     ids = rng.integers(0, n, 16)
     _ground_truth_check(g, full, ids)
     assert g.cache_count == n
+
+
+def test_prob_ordered_cache(small_graph, rng):
+    """prob= puts high-probability rows in the hot tier."""
+    n = small_graph.node_count
+    full = rng.normal(size=(n, 8)).astype(np.float32)
+    prob = rng.uniform(0, 1, n)
+    budget = 8 * 4 * (n // 4)
+    f = Feature(device_cache_size=budget).from_cpu_tensor(
+        full.copy(), prob=prob
+    )
+    assert 0 < f.cache_count < n
+    hot_old = np.nonzero(f.feature_order < f.cache_count)[0]
+    cold_old = np.nonzero(f.feature_order >= f.cache_count)[0]
+    assert prob[hot_old].min() >= prob[cold_old].max()
+    ids = rng.integers(0, n, 64)
+    np.testing.assert_allclose(np.asarray(f[ids]), full[ids], rtol=1e-6)
