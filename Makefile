@@ -1,0 +1,22 @@
+# Top-level convenience targets (parity: reference ./configure && make).
+.PHONY: all native test test-native asan bench smoke
+
+all: native
+
+native:
+	$(MAKE) -C quiver_tpu/cpp
+
+test:
+	python -m pytest tests/ -q
+
+test-native:
+	$(MAKE) -C quiver_tpu/cpp test
+
+asan:
+	$(MAKE) -C quiver_tpu/cpp asan
+
+bench:
+	python bench.py
+
+smoke:
+	python bench.py --small --iters 5

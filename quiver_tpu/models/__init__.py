@@ -2,3 +2,4 @@ from .layers import SAGEConv, GATConv
 from .sage import GraphSAGE
 from .gat import GAT
 from .rgat import RGAT
+from .gcn import GCN, GCNConv

@@ -76,3 +76,33 @@ def test_masked_padding_does_not_leak(small_graph, rng):
     o1 = np.asarray(model.apply(params, jnp.asarray(x1), b.layers))
     o2 = np.asarray(model.apply(params, jnp.asarray(x2), b.layers))
     np.testing.assert_allclose(o1[:8], o2[:8], rtol=1e-5)
+
+
+def test_gcn_forward_and_trains(small_graph, rng):
+    import optax
+
+    from quiver_tpu.models import GCN
+
+    s = GraphSageSampler(small_graph, [4, 3])
+    seeds = np.arange(16, dtype=np.int64)
+    b = s.sample(seeds, key=jax.random.PRNGKey(4))
+    x = jnp.asarray(rng.normal(size=(b.n_id.shape[0], 12)), jnp.float32)
+    model = GCN(hidden=16, out_dim=5, num_layers=2, dropout=0.0)
+    params = model.init(jax.random.PRNGKey(0), x, b.layers)
+    out = model.apply(params, x, b.layers)
+    assert out.shape == (16, 5)
+    labels = jnp.asarray(rng.integers(0, 5, 16))
+    tx = optax.adam(1e-2)
+    opt = tx.init(params)
+
+    def loss_fn(p):
+        return optax.softmax_cross_entropy_with_integer_labels(
+            model.apply(p, x, b.layers), labels
+        ).mean()
+
+    l0 = float(loss_fn(params))
+    for _ in range(5):
+        g = jax.grad(loss_fn)(params)
+        upd, opt = tx.update(g, opt, params)
+        params = optax.apply_updates(params, upd)
+    assert float(loss_fn(params)) < l0
