@@ -25,6 +25,11 @@ def main():
     ap.add_argument("--batch-size", type=int, default=1024)
     ap.add_argument("--cache", default="800M")
     ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--fused", action="store_true",
+                    help="force the fused one-jit pipeline (requires the "
+                         "cache budget to cover all features)")
+    ap.add_argument("--profile", default=None,
+                    help="dump a jax.profiler trace to this dir")
     args = ap.parse_args()
 
     import jax
@@ -64,25 +69,56 @@ def main():
     n_batches = len(train_idx) // B
     ones = jnp.ones((B,), bool)
 
+    fused = None
+    if args.fused or feature.cache_count >= feature.node_count:
+        from quiver_tpu.pipeline import make_fused_train_step
+
+        fused = make_fused_train_step(
+            sampler, feature,
+            lambda p, x, blocks, train=False, rngs=None: model.apply(
+                p, x, blocks, train=train, rngs=rngs
+            ), tx,
+        )
+        print("pipeline: fused (sample+gather+step in one jit)")
+    else:
+        print("pipeline: two-stage (prefetch + step)")
+
     def make_batch(i):
         seeds = train_idx[i * B: (i + 1) * B]
         batch = sampler.sample(seeds, key=jax.random.PRNGKey(i))
         x = feature[np.asarray(batch.n_id)]
         return batch, x, jnp.asarray(labels[seeds])
 
-    for epoch in range(args.epochs):
-        rng.shuffle(train_idx)
-        t0 = time.perf_counter()
-        loss = None
-        for batch, x, lab in Prefetcher(range(n_batches), make_batch,
-                                        depth=2):
-            state, loss = step(state, x, batch.layers, lab, ones,
-                               jax.random.PRNGKey(1))
-        jax.block_until_ready(loss)
-        dt = time.perf_counter() - t0
-        print(f"epoch {epoch}: {dt:.2f}s "
-              f"({n_batches} batches, {dt / n_batches * 1e3:.1f} ms/batch) "
-              f"loss={float(loss):.3f}")
+    import contextlib
+
+    prof = (
+        jax.profiler.trace(args.profile) if args.profile
+        else contextlib.nullcontext()
+    )
+    with prof:
+        for epoch in range(args.epochs):
+            rng.shuffle(train_idx)
+            t0 = time.perf_counter()
+            loss = None
+            if fused is not None:
+                for i in range(n_batches):
+                    host_seeds = train_idx[i * B: (i + 1) * B]
+                    state, loss = fused(
+                        state, jnp.asarray(host_seeds, jnp.int32),
+                        jnp.asarray(labels[host_seeds]), ones,
+                        jax.random.PRNGKey(i),
+                    )
+            else:
+                for batch, x, lab in Prefetcher(range(n_batches),
+                                                make_batch, depth=2):
+                    state, loss = step(state, x, batch.layers, lab, ones,
+                                       jax.random.PRNGKey(1))
+            jax.block_until_ready(loss)
+            dt = time.perf_counter() - t0
+            print(f"epoch {epoch}: {dt:.2f}s "
+                  f"({n_batches} batches, "
+                  f"{dt / n_batches * 1e3:.1f} ms/batch) "
+                  f"loss={float(loss):.3f}")
     print("reference bar: quiver 1-GPU 11.1s/epoch, 4-GPU 3.25s "
           "(products, real data)")
 
