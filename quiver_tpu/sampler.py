@@ -28,7 +28,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .ops.sample import sample_neighbors
+from .ops.sample import (sample_neighbors, sample_neighbors_weighted,
+                         row_cumsum_weights)
 from .ops.reindex import reindex
 from .utils.topology import CSRTopo
 
@@ -81,7 +82,7 @@ class SampledBatch(NamedTuple):
 
 
 def _sample_pipeline_nodedup(indptr, indices, seeds, key, sizes,
-                             gather_mode="xla"):
+                             gather_mode="xla", cum_weights=None):
     """Traced multi-hop pipeline WITHOUT dedup — the TPU hot path.
 
     Design note (why no hash table / no sort): the reference dedups every
@@ -102,8 +103,14 @@ def _sample_pipeline_nodedup(indptr, indices, seeds, key, sizes,
     blocks = []
     keys = jax.random.split(key, len(sizes))
     for l, k in enumerate(sizes):
-        out = sample_neighbors(indptr, indices, frontier, k, keys[l],
-                               seed_mask=fmask, gather_mode=gather_mode)
+        if cum_weights is not None:
+            out = sample_neighbors_weighted(indptr, indices, cum_weights,
+                                            frontier, k, keys[l],
+                                            seed_mask=fmask)
+        else:
+            out = sample_neighbors(indptr, indices, frontier, k, keys[l],
+                                   seed_mask=fmask,
+                                   gather_mode=gather_mode)
         t = frontier.shape[0]
         pos = (t + jnp.arange(t, dtype=jnp.int32)[:, None] * k
                + jnp.arange(k, dtype=jnp.int32)[None, :])
@@ -169,12 +176,16 @@ class GraphSageSampler:
       dedup: ``"none"`` (default, TPU hot path — positional relabel, no
         sort; frontier may contain duplicate nodes) or ``"hop"``
         (reference-parity exact dedup each hop via ``ops.reindex``).
+      edge_weights: optional ``[E]`` weights; hops then draw neighbors
+        weight-proportionally WITH replacement
+        (``ops.sample_neighbors_weighted``, reference weight_sample path).
     """
 
     def __init__(self, csr_topo: CSRTopo, sizes: Sequence[int], device=None,
                  mode: str = "TPU",
                  frontier_caps: Optional[Sequence[Optional[int]]] = None,
-                 dedup: str = "none", gather_mode: str = "auto"):
+                 dedup: str = "none", gather_mode: str = "auto",
+                 edge_weights=None):
         assert mode in ("TPU", "CPU", "UVA", "GPU"), mode
         if mode in ("UVA", "GPU"):  # compat aliases from the reference API
             mode = "TPU"
@@ -199,6 +210,15 @@ class GraphSageSampler:
         assert len(self.frontier_caps) == len(self.sizes)
         self._jitted = None
         self._cpu = None
+        self._cum_weights = None
+        if edge_weights is not None:
+            assert mode == "TPU" and dedup == "none", (
+                "weighted sampling: TPU mode, dedup='none' only"
+            )
+            cw = row_cumsum_weights(csr_topo.indptr, edge_weights)
+            import jax.numpy as _jnp
+
+            self._cum_weights = _jnp.asarray(cw)
         if mode == "TPU":
             csr_topo.to_device(device)
 
@@ -220,12 +240,14 @@ class GraphSageSampler:
         caps = tuple(self.frontier_caps)
         dedup = self.dedup
         gm = self.gather_mode
+        cw = self._cum_weights
 
         @jax.jit
         def fn(seeds, key):
             if dedup == "none":
                 return _sample_pipeline_nodedup(indptr, indices, seeds, key,
-                                                sizes, gather_mode=gm)
+                                                sizes, gather_mode=gm,
+                                                cum_weights=cw)
             return _sample_pipeline(indptr, indices, seeds, key, sizes, caps,
                                     gather_mode=gm)
 

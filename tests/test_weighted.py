@@ -57,3 +57,22 @@ def test_weighted_sample_distribution(wgraph):
     freq10 = counts[10] / total
     assert 0.7 < freq10 < 0.9, counts  # expect ~0.8
     assert counts[11] > counts[12] + counts[13] - 30
+
+
+def test_weighted_sampler_end_to_end(small_graph, rng):
+    from quiver_tpu import GraphSageSampler
+
+    w = rng.uniform(0.1, 1.0, small_graph.edge_count).astype(np.float32)
+    s = GraphSageSampler(small_graph, [4, 3], edge_weights=w)
+    seeds = np.arange(16, dtype=np.int64)
+    b = s.sample(seeds, key=jax.random.PRNGKey(0))
+    n_id = np.asarray(b.n_id)
+    blk = b.layers[-1]
+    local = np.asarray(blk.nbr_local)
+    m = np.asarray(blk.mask)
+    for v in range(16):
+        row = set(small_graph.indices[
+            small_graph.indptr[v]: small_graph.indptr[v + 1]].tolist())
+        for j in range(4):
+            if m[v, j]:
+                assert n_id[local[v, j]] in row
