@@ -233,6 +233,26 @@ class GraphSageSampler:
     def reindex(self, inputs, nbrs, mask):
         return reindex(jnp.asarray(np.asarray(inputs), jnp.int32), nbrs, mask)
 
+    def sample_sub(self, seeds, size: int, key=None):
+        """One-hop subgraph extraction: dedup'd node set + relabeled COO.
+
+        Parity: ``TorchQuiver::sample_sub`` (quiver_sample.cu:258-303) —
+        returns ``(nodes, row, col)`` where ``nodes[:len(seeds)] == seeds``
+        and (row, col) are local-id edges of the sampled subgraph.
+        """
+        seeds = np.asarray(seeds)
+        out = self.sample_layer(seeds, size, key=key)
+        r = self.reindex(seeds, out.nbrs, out.mask)
+        num = int(r.num_nodes)
+        nodes = np.asarray(r.n_id)[:num]
+        m = np.asarray(r.mask)
+        local = np.asarray(r.local_nbrs)
+        row = np.repeat(np.arange(len(seeds)), out.nbrs.shape[1]).reshape(
+            m.shape
+        )[m]
+        col = local[m]
+        return nodes, row, col
+
     # -- multi-hop API ------------------------------------------------
     def _build_jit(self, batch_size: int):
         indptr, indices = self.csr_topo.to_device(self.device)

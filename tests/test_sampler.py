@@ -145,3 +145,16 @@ def test_sample_prob_recurrence(small_graph):
     # nodes unreachable in 2 hops from train set have zero prob
     # (probabilistic smoke: total mass is positive)
     assert p.sum() > 0
+
+
+def test_sample_sub(small_graph):
+    s = GraphSageSampler(small_graph, [4])
+    seeds = np.array([0, 3, 7], dtype=np.int64)
+    nodes, row, col = s.sample_sub(seeds, 4, key=jax.random.PRNGKey(0))
+    np.testing.assert_array_equal(nodes[:3], seeds)
+    assert len(row) == len(col)
+    for r, c in zip(row, col):
+        tgt, src = nodes[r], nodes[c]
+        rowset = small_graph.indices[
+            small_graph.indptr[tgt]: small_graph.indptr[tgt + 1]]
+        assert src in rowset
