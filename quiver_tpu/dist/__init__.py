@@ -1,3 +1,5 @@
 from .comm import TpuComm, getNcclId
 from .feature import DistFeature, PartitionInfo
 from .sampler import DistGraphSampler, shard_csr_by_rows
+from .init import initialize, make_hybrid_mesh
+from .ring import RingFeature

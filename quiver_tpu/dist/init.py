@@ -1,0 +1,61 @@
+"""Multi-host bootstrap + hybrid DCN x ICI meshes.
+
+Reference parity: the NCCL-id bootstrap via torch TCPStore
+(``benchmarks/ogbn-papers100M/train_quiver_multi_node.py:405-411``) and the
+HostRankTable (``comm.py:5-39``).  In jax the id exchange is
+``jax.distributed.initialize`` and the rank table is the device list's
+``process_index`` — what remains worth wrapping is the **mesh layout**:
+put the fast axis (ICI, intra-slice) minor and the slow axis (DCN,
+cross-host) major, so feature shards exchange over ICI within a host
+group and only partition traffic crosses DCN (the same NVLink-clique /
+NCCL-tier split the reference hand-builds).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["initialize", "make_hybrid_mesh"]
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None):
+    """``jax.distributed.initialize`` passthrough (no-op if single
+    process or already initialized)."""
+    import jax
+
+    try:
+        if coordinator_address is not None:
+            jax.distributed.initialize(coordinator_address, num_processes,
+                                       process_id)
+        else:
+            jax.distributed.initialize()
+    except (RuntimeError, ValueError):
+        pass  # single-process / already initialized
+    return jax.process_count(), jax.process_index()
+
+
+def make_hybrid_mesh(ici_axis: str = "ici", dcn_axis: str = "dcn"):
+    """Mesh [n_hosts, devices_per_host] with DCN major, ICI minor.
+
+    On a single process this degenerates to [1, n_devices] — code written
+    against the two axes runs unchanged (collectives over a size-1 axis
+    are no-ops).
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    devs = jax.devices()
+    by_proc = {}
+    for d in devs:
+        by_proc.setdefault(d.process_index, []).append(d)
+    n_proc = len(by_proc)
+    per = min(len(v) for v in by_proc.values())
+    grid = np.array(
+        [sorted(v, key=lambda d: d.id)[:per]
+         for _, v in sorted(by_proc.items())]
+    )
+    return Mesh(grid, (dcn_axis, ici_axis))

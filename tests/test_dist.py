@@ -114,3 +114,23 @@ def test_partition_to_distfeature_roundtrip(mesh, tmp_path, rng):
     out = np.asarray(df.lookup(ids))
     for h in range(NHOSTS):
         np.testing.assert_allclose(out[h], feature[ids[h]], rtol=1e-6)
+
+
+def test_hybrid_mesh_degenerate():
+    from quiver_tpu.dist import make_hybrid_mesh
+
+    mesh = make_hybrid_mesh()
+    assert mesh.axis_names == ("dcn", "ici")
+    assert int(np.prod(list(mesh.shape.values()))) == NHOSTS
+
+
+def test_ring_feature_lookup(mesh, rng):
+    from quiver_tpu.dist import RingFeature
+
+    n, d = 100, 8  # NOT a multiple of 8 devices -> exercises padding
+    full = rng.normal(size=(n, d)).astype(np.float32)
+    rf = RingFeature(full, mesh)
+    ids = rng.integers(0, n, (NHOSTS, 24)).astype(np.int32)
+    out = np.asarray(rf.lookup(ids))
+    for h in range(NHOSTS):
+        np.testing.assert_allclose(out[h], full[ids[h]], rtol=1e-6)
