@@ -75,6 +75,39 @@ def make_fused_train_step(sampler: GraphSageSampler, feature: Feature,
     return step
 
 
+def make_scan_epoch(sampler: GraphSageSampler, feature: Feature,
+                    apply_fn: Callable, tx: optax.GradientTransformation,
+                    loss_fn: Optional[Callable] = None):
+    """Whole-epoch ``lax.scan`` variant of the fused step.
+
+    ``(state, seeds [S, B], labels [S, B], key) -> (state, losses [S])`` —
+    S steps execute as ONE device program: no per-step dispatch at all.
+    Compile cost is paid once per (S, B) shape; use for steady production
+    epochs, the plain fused step for interactive work.
+    """
+    _check(feature)
+    step = make_fused_train_step(sampler, feature, apply_fn, tx, loss_fn)
+    # reuse the already-jitted step inside scan: re-expressing it as a
+    # traced body lets XLA pipeline across steps
+    indptr, indices = sampler.csr_topo.to_device(sampler.device)
+
+    @jax.jit
+    def epoch(state: TrainState, seeds, labels, key):
+        S, B = seeds.shape
+        ones = jnp.ones((B,), bool)
+
+        def body(state, xs):
+            s, l, k = xs
+            state, loss = step(state, s, l, ones, k)
+            return state, loss
+
+        keys = jax.random.split(key, S)
+        state, losses = jax.lax.scan(body, state, (seeds, labels, keys))
+        return state, losses
+
+    return epoch
+
+
 def make_fused_eval_fn(sampler: GraphSageSampler, feature: Feature,
                        apply_fn: Callable):
     """``(params, seeds, key) -> logits`` with sampling inside the jit."""

@@ -68,3 +68,31 @@ def test_fused_requires_full_cache(setup):
     with pytest.raises(AssertionError):
         make_fused_train_step(sampler, partial, lambda *a, **k: None,
                               optax.adam(1e-3))
+
+
+def test_scan_epoch(setup):
+    import optax
+
+    from quiver_tpu.pipeline import make_scan_epoch
+
+    topo, feature, sampler, model, comm = setup
+    tx = optax.adam(1e-2)
+    rng = np.random.default_rng(1)
+    B, S = 32, 6
+    b0 = sampler.sample(np.arange(B, dtype=np.int64))
+    params = model.init(jax.random.PRNGKey(0), feature[b0.n_id], b0.layers)
+    state = TrainState.create(params, tx)
+    epoch = make_scan_epoch(
+        sampler, feature,
+        lambda p, x, blocks, train=False, rngs=None: model.apply(
+            p, x, blocks, train=train, rngs=rngs
+        ), tx,
+    )
+    seeds = jnp.asarray(rng.integers(0, topo.node_count, (S, B)), jnp.int32)
+    labels = jnp.asarray(np.asarray(comm)[np.asarray(seeds)])
+    state, losses = epoch(state, seeds, labels, jax.random.PRNGKey(5))
+    assert losses.shape == (S,)
+    assert np.isfinite(np.asarray(losses)).all()
+    # a second epoch continues to improve
+    state, losses2 = epoch(state, seeds, labels, jax.random.PRNGKey(6))
+    assert float(losses2.mean()) < float(losses.mean())
