@@ -42,9 +42,27 @@ def bench_sampling(indptr, indices, batch_size, sizes, iters, warmup=3):
     log(f"graph upload: {time.perf_counter() - t0:.2f}s "
         f"(N={topo.node_count:,}, E={topo.edge_count:,})")
 
-    sampler = GraphSageSampler(topo, sizes)
+    # pick the faster gather mode empirically (hardware-dependent: lanes
+    # wins where XLA serializes 1-D gathers, xla wins elsewhere)
     n = topo.node_count
     rng = np.random.default_rng(1)
+    probe_seeds = rng.integers(0, n, batch_size).astype(np.int32)
+    best_mode, best_dt = None, float("inf")
+    for gm in ("lanes", "xla"):
+        import jax as _jax
+
+        s = GraphSageSampler(topo, sizes, gather_mode=gm)
+        s.sample(probe_seeds).n_id.block_until_ready()  # compile
+        t0 = time.perf_counter()
+        for r in range(2):
+            s.sample(probe_seeds,
+                     key=_jax.random.PRNGKey(r)).n_id.block_until_ready()
+        dt = time.perf_counter() - t0
+        log(f"gather_mode={gm}: {dt / 2 * 1e3:.1f} ms/batch")
+        if dt < best_dt:
+            best_mode, best_dt = gm, dt
+    log(f"selected gather_mode={best_mode}")
+    sampler = GraphSageSampler(topo, sizes, gather_mode=best_mode)
     seed_batches = [
         rng.integers(0, n, batch_size).astype(np.int32)
         for _ in range(iters + warmup)

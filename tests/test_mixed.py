@@ -35,3 +35,20 @@ def test_reference_mode_aliases(small_graph):
     job = RangeSampleJob(ids, batch_size=16)
     s = MixedGraphSageSampler(small_graph, [3], job, mode="UVA_CPU_MIXED")
     assert s.mode == "TPU_CPU_MIXED"
+
+
+def test_mixed_feedback_steady_state(small_graph):
+    """After an epoch with timing data, the CPU share responds to the
+    measured time ratio (parity: decide_task_num feedback)."""
+    ids = np.arange(small_graph.node_count, dtype=np.int64)
+    job = RangeSampleJob(ids, batch_size=16)
+    s = MixedGraphSageSampler(small_graph, [3], job, mode="TPU_CPU_MIXED",
+                              num_workers=2)
+    list(s)  # epoch 1 populates avg times
+    assert s.avg_tpu_time is not None
+    # force an extreme ratio: TPU "fast", CPU "slow" -> tiny CPU share
+    s.avg_tpu_time, s.avg_cpu_time = 1e-4, 1.0
+    assert s._decide_cpu_share(100) <= 1
+    # CPU fast, TPU slow -> CPU takes nearly everything
+    s.avg_tpu_time, s.avg_cpu_time = 1.0, 1e-4
+    assert s._decide_cpu_share(100) >= 95
