@@ -11,12 +11,35 @@ Prints ONE JSON line; details go to stderr.
 
 import argparse
 import json
+import os
 import sys
+import threading
 import time
 
 import numpy as np
 
+# persistent XLA compile cache: driver reruns skip the 20-40s compiles
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
+)
+
 BASELINE_SEPS = 34.29e6
+
+
+def _watchdog(seconds: float, stage: dict):
+    """Abort instead of hanging forever if the device tunnel is dead."""
+
+    def check():
+        if not stage.get("device_ready"):
+            print(f"bench watchdog: no TPU after {seconds:.0f}s "
+                  f"(tunnel down?) — aborting", file=sys.stderr, flush=True)
+            os._exit(3)
+
+    t = threading.Timer(seconds, check)
+    t.daemon = True
+    t.start()
+    return t
 
 
 def log(*a):
@@ -134,6 +157,13 @@ def main():
         n_nodes, n_edges = 2_449_029, 123_718_280
         batch, sizes = 1024, [15, 10, 5]
         feat_nodes, feat_dim, feat_rows = 2_449_029, 100, 500_000
+
+    stage = {}
+    _watchdog(600.0, stage)
+    import jax
+
+    jax.devices()  # force device init under the watchdog
+    stage["device_ready"] = True
 
     t0 = time.perf_counter()
     indptr, indices = build_graph(n_nodes, n_edges)
