@@ -172,7 +172,8 @@ class InferenceServer:
     def __init__(self, tpu_sampler, feature, apply_fn: Callable, params,
                  device_batched_queue: "queue.Queue",
                  cpu_sampled_queue: Optional["queue.Queue"] = None,
-                 result_queue: Optional["queue.Queue"] = None):
+                 result_queue: Optional["queue.Queue"] = None,
+                 max_coalesce: int = 8):
         self.sampler = tpu_sampler
         self.feature = feature
         self.apply_fn = apply_fn
@@ -180,6 +181,7 @@ class InferenceServer:
         self.device_q = device_batched_queue
         self.cpu_q = cpu_sampled_queue
         self.result_queue = result_queue or queue.Queue()
+        self.max_coalesce = max_coalesce
         self._threads: List[threading.Thread] = []
         self._stopped = threading.Event()
 
@@ -214,12 +216,59 @@ class InferenceServer:
         except Exception as e:  # noqa: BLE001 — lane must survive
             self.result_queue.put((req, e))
 
+    def _drain_coalesce(self, first: ServingRequest):
+        """Pull queued requests (non-blocking) to batch one device pass —
+        under load many small requests share a single bucketed forward,
+        which is where the TPU's throughput lives."""
+        reqs = [first]
+        budget = self.BUCKETS[-1] - len(first.ids)
+        while len(reqs) < self.max_coalesce and budget > 0:
+            try:
+                item = self.device_q.get_nowait()
+            except queue.Empty:
+                break
+            if item is _STOP:
+                self.device_q.put(_STOP)  # re-post for the loop to see
+                break
+            if len(item.ids) > budget:
+                self.device_q.put(item)
+                break
+            reqs.append(item)
+            budget -= len(item.ids)
+        return reqs
+
+    def _infer_coalesced(self, reqs):
+        ids = np.concatenate([np.asarray(r.ids) for r in reqs])
+        padded = self._pad_ids(ids)
+        batch = self.sampler.sample(padded)
+        x = self.feature[np.asarray(batch.n_id)]
+        out = np.asarray(self.apply_fn(self.params, x, batch.layers))
+        off = 0
+        outs = []
+        for r in reqs:
+            outs.append(out[off: off + len(r.ids)])
+            off += len(r.ids)
+        return outs
+
     def _device_loop(self):
         while not self._stopped.is_set():
             item = self.device_q.get()
             if item is _STOP:
                 break
-            self._safe(item, self._infer_device, item)
+            reqs = (
+                self._drain_coalesce(item) if self.max_coalesce > 1
+                else [item]
+            )
+            try:
+                outs = self._infer_coalesced(reqs)
+                for r, o in zip(reqs, outs):
+                    self._finish(r, o)
+            except Exception as e:  # noqa: BLE001 — lane must survive
+                for r in reqs:
+                    self.result_queue.put((r, e))
+
+    def _finish(self, req, out):
+        self.result_queue.put((req, out))
 
     def _cpu_loop(self):
         while not self._stopped.is_set():
@@ -278,6 +327,10 @@ class InferenceServer_Debug(InferenceServer):
             self.result_queue.put((req, out))
         except Exception as e:  # noqa: BLE001
             self.result_queue.put((req, e))
+
+    def _finish(self, req, out):
+        self._record(req)
+        self.result_queue.put((req, out))
 
     def stats(self) -> dict:
         lat = np.asarray(sorted(self.latencies))

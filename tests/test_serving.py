@@ -124,7 +124,8 @@ def test_server_lane_survives_errors(small_graph, rng):
         return model.apply(p, x, blocks)
 
     dq = queue.Queue()
-    server = InferenceServer(sampler, feature, apply_fn, params, dq).start()
+    server = InferenceServer(sampler, feature, apply_fn, params, dq,
+                             max_coalesce=1).start()
     dq.put(ServingRequest(ids=np.array([1, 2, 3]), client=0, seq=0))
     dq.put(ServingRequest(ids=np.array([4, 5]), client=0, seq=1))
     r0 = server.result_queue.get(timeout=60)
@@ -133,3 +134,37 @@ def test_server_lane_survives_errors(small_graph, rng):
     outs = {r0[0].seq: r0[1], r1[0].seq: r1[1]}
     assert isinstance(outs[0], RuntimeError)
     assert outs[1].shape == (2, 2)
+
+
+def test_device_lane_coalesces(small_graph, rng):
+    """Multiple queued requests share one forward pass; outputs split
+    correctly per request."""
+    n = small_graph.node_count
+    feat = rng.normal(size=(n, 4)).astype(np.float32)
+    feature = Feature(device_cache_size="1G").from_cpu_tensor(feat)
+    sampler = GraphSageSampler(small_graph, [3])
+    model = GraphSAGE(hidden=8, out_dim=2, num_layers=1, dropout=0.0)
+    b0 = sampler.sample(np.arange(8, dtype=np.int64))
+    params = model.init(jax.random.PRNGKey(0),
+                        feature[np.asarray(b0.n_id)], b0.layers)
+    forwards = {"n": 0}
+
+    def apply_fn(p, x, blocks):
+        forwards["n"] += 1
+        return model.apply(p, x, blocks)
+
+    dq = queue.Queue()
+    # enqueue BEFORE starting so the loop sees a full queue to coalesce
+    sizes = [3, 5, 2, 4]
+    for i, s in enumerate(sizes):
+        dq.put(ServingRequest(ids=rng.integers(0, n, s), client=0, seq=i))
+    server = InferenceServer(sampler, feature, apply_fn, params, dq,
+                             max_coalesce=8).start()
+    got = {}
+    for _ in sizes:
+        req, out = server.result_queue.get(timeout=60)
+        got[req.seq] = out
+    server.stop()
+    assert forwards["n"] < len(sizes)  # coalescing happened
+    for i, s in enumerate(sizes):
+        assert got[i].shape == (s, 2)
