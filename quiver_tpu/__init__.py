@@ -29,6 +29,8 @@ from .feature import Feature, DeviceConfig
 from .dist.feature import DistFeature, PartitionInfo
 from .dist.comm import TpuComm
 from .dist.sampler import DistGraphSampler
+from .dist.ring import RingFeature
+from .dist.init import initialize as distributed_initialize, make_hybrid_mesh
 from .partition import (
     partition_without_replication,
     quiver_partition_feature,
@@ -60,6 +62,7 @@ __all__ = [
     "HeteroLayerBlock",
     "Feature", "DeviceConfig",
     "DistFeature", "PartitionInfo", "TpuComm", "DistGraphSampler",
+    "RingFeature", "distributed_initialize", "make_hybrid_mesh",
     "partition_without_replication", "quiver_partition_feature",
     "load_quiver_feature_partition",
     "generate_neighbour_num",
