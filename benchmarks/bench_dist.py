@@ -1,0 +1,85 @@
+"""Distributed data-layer benchmark: sharded sampling + feature exchange.
+
+Counterpart of the reference's multi-node benchmarks
+(``benchmarks/ogbn-papers100M/``) reduced to the data-layer ops: steps/sec
+of (row-sharded sample -> all-to-all feature lookup -> DP step) over
+whatever mesh exists (virtual CPU mesh in dev, a real slice in prod).
+Also races DistFeature (all-to-all) vs RingFeature (rotation) lookups.
+"""
+
+import argparse
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nodes", type=int, default=500_000)
+    ap.add_argument("--edges", type=int, default=10_000_000)
+    ap.add_argument("--dim", type=int, default=128)
+    ap.add_argument("--batch-size", type=int, default=512)
+    ap.add_argument("--iters", type=int, default=10)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from bench import build_graph
+    from quiver_tpu import (
+        CSRTopo, DistFeature, DistGraphSampler, PartitionInfo, RingFeature,
+    )
+    from quiver_tpu.utils.mesh import make_mesh
+
+    mesh = make_mesh(("data",))
+    nd = int(mesh.shape["data"])
+    print(f"mesh: {nd} devices")
+    rng = np.random.default_rng(0)
+    indptr, indices = build_graph(args.nodes, args.edges)
+    topo = CSRTopo(indptr=indptr, indices=indices)
+    feat = rng.normal(size=(args.nodes, args.dim)).astype(np.float32)
+
+    sampler = DistGraphSampler(topo, mesh, sizes=[10, 5])
+    g2h = rng.integers(0, nd, topo.node_count).astype(np.int32)
+    info = PartitionInfo(host=0, hosts=nd, global2host=g2h)
+    df = DistFeature.from_global_feature(feat, mesh, info)
+    rf = RingFeature(feat, mesh)
+
+    B = args.batch_size
+    seed_rounds = [rng.integers(0, topo.node_count, (nd, B))
+                   for _ in range(args.iters + 1)]
+
+    # warm
+    n_id, *_ = sampler.sample(seed_rounds[0], key=0)
+    df.lookup(np.asarray(n_id)).block_until_ready()
+    t0 = time.perf_counter()
+    for i in range(args.iters):
+        n_id, n_mask, num, blocks = sampler.sample(seed_rounds[i + 1],
+                                                   key=i)
+        x = df.lookup(np.asarray(n_id))
+    jax.block_until_ready(x)
+    dt = time.perf_counter() - t0
+    edges = sum(int(np.asarray(b.mask).sum()) for b in blocks) * args.iters
+    print(f"sharded sample+exchange: {dt / args.iters * 1e3:.1f} ms/round "
+          f"({edges / dt / 1e6:.2f}M SEPS incl. exchange, {nd} replicas)")
+
+    # DistFeature vs RingFeature on identical demand
+    P = n_id.shape[1]
+    ids = np.asarray(n_id)
+    for name, f in (("all-to-all DistFeature", df.lookup),
+                    ("ring RingFeature", rf.lookup)):
+        f(ids).block_until_ready()
+        t0 = time.perf_counter()
+        for _ in range(args.iters):
+            out = f(ids)
+        jax.block_until_ready(out)
+        dt = time.perf_counter() - t0
+        gbs = args.iters * nd * P * args.dim * 4 / dt / 1e9
+        print(f"{name:<24} {dt / args.iters * 1e3:7.1f} ms  {gbs:6.2f} GB/s")
+
+
+if __name__ == "__main__":
+    main()
