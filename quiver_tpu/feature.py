@@ -137,25 +137,30 @@ class Feature:
         self.cache_count = cache_count
         hot_np = np.ascontiguousarray(tensor[:cache_count], dtype=dt)
         self.cold = np.ascontiguousarray(tensor[cache_count:], dtype=dt)
-
-        if cache_count > 0:
-            if self.cache_policy == "ici_shard" and self.mesh is not None:
-                from jax.sharding import NamedSharding, PartitionSpec as P
-
-                axis = self.mesh.axis_names[0]
-                pad = (-cache_count) % np.prod(self.mesh.devices.shape)
-                if pad:
-                    hot_np = np.concatenate(
-                        [hot_np, np.zeros((pad, self.dim), dtype=dt)]
-                    )
-                self.hot = jax.device_put(
-                    hot_np, NamedSharding(self.mesh, P(axis, None))
-                )
-            else:
-                self.hot = jnp.asarray(hot_np)
-        else:
-            self.hot = jnp.zeros((0, self.dim), dtype=dt)
+        self.hot = self._place_hot(hot_np, dt)
         return self
+
+    def _place_hot(self, hot_np, dt):
+        """Put the hot tier in HBM — replicated, or sharded over the mesh
+        (``ici_shard``, the p2p-clique equivalent)."""
+        import jax
+        import jax.numpy as jnp
+
+        if hot_np.shape[0] == 0:
+            return jnp.zeros((0, self.dim), dtype=dt)
+        if self.cache_policy == "ici_shard" and self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            axis = self.mesh.axis_names[0]
+            pad = (-hot_np.shape[0]) % np.prod(self.mesh.devices.shape)
+            if pad:
+                hot_np = np.concatenate(
+                    [hot_np, np.zeros((pad, self.dim), dtype=dt)]
+                )
+            return jax.device_put(
+                hot_np, NamedSharding(self.mesh, P(axis, None))
+            )
+        return jnp.asarray(hot_np)
 
     @classmethod
     def from_mmap(cls, path_or_array, device_config: DeviceConfig = None,
@@ -177,11 +182,11 @@ class Feature:
             shards = [np.load(p, mmap_mode="r")
                       for p in device_config.device_paths]
             hot_np = np.concatenate([np.asarray(s) for s in shards])
-            self.hot = jnp.asarray(hot_np)
             self.cache_count = hot_np.shape[0]
             self.cold = arr
             self.node_count = self.cache_count + arr.shape[0]
             self.dim = arr.shape[1]
+            self.hot = self._place_hot(hot_np, hot_np.dtype)
             return self
         # budgeted split over the mmap
         self.node_count, self.dim = arr.shape
@@ -189,10 +194,10 @@ class Feature:
         cache_count = min(
             self._budget_rows(row_bytes, self._n_devices()), self.node_count
         )
-        import jax.numpy as jnp
-
         self.cache_count = cache_count
-        self.hot = jnp.asarray(np.asarray(arr[:cache_count]))
+        self.hot = self._place_hot(
+            np.ascontiguousarray(arr[:cache_count]), arr.dtype
+        )
         self.cold = arr[cache_count:]
         return self
 

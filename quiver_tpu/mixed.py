@@ -126,10 +126,13 @@ class MixedGraphSageSampler:
             for t in task_ids:
                 if stop.is_set():
                     return
-                t0 = time.perf_counter()
-                batch = self.cpu_sampler.sample(self.job[t])
-                cpu_times.append(time.perf_counter() - t0)
-                results.put((batch, "cpu"))
+                try:
+                    t0 = time.perf_counter()
+                    batch = self.cpu_sampler.sample(self.job[t])
+                    cpu_times.append(time.perf_counter() - t0)
+                    results.put((batch, "cpu"))
+                except BaseException as e:  # surface to the consumer
+                    results.put((e, "error"))
 
         threads = []
         if cpu_tasks and self.cpu_sampler is not None:
@@ -153,10 +156,16 @@ class MixedGraphSageSampler:
                 yield batch, "tpu"
                 produced += 1
                 while not results.empty():
-                    yield results.get_nowait()
+                    item = results.get_nowait()
+                    if item[1] == "error":
+                        raise item[0]
+                    yield item
                     produced += 1
             while produced < n:
-                yield results.get()
+                item = results.get(timeout=300)
+                if item[1] == "error":
+                    raise item[0]
+                yield item
                 produced += 1
         finally:
             stop.set()
