@@ -46,10 +46,14 @@ def _kernel(idx_ref, table_ref, out_ref, sem):
             get_dma(w, w).start()
 
     def body(i, _):
+        # wait i FIRST: its semaphore slot (i % NBUF) is the same slot
+        # DMA i+NBUF will use, so the slot must drain before reuse
+        get_dma(i % NBUF, i).wait()
+
         @pl.when(i + NBUF < blk)
         def _():
             get_dma((i + NBUF) % NBUF, i + NBUF).start()
-        get_dma(i % NBUF, i).wait()
+
         return 0
 
     jax.lax.fori_loop(0, blk, body, 0)
