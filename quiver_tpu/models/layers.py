@@ -72,9 +72,11 @@ class GATConv(nn.Module):
                            (h, f))
         e_src = (w_src * a_src).sum(-1)                      # [T, k, H]
         e_tgt = (w_tgt * a_tgt).sum(-1)                      # [T, H]
-        # self-loop joins the neighbor set, as in GATConv(add_self_loops)
+        # self-loop joins the neighbor set, as in GATConv(add_self_loops);
+        # its source-side term uses a_src on the node's own features
+        e_self = (w_tgt * a_src).sum(-1) + e_tgt             # [T, H]
         e = nn.leaky_relu(
-            jnp.concatenate([e_src + e_tgt[:, None], 2 * e_tgt[:, None]],
+            jnp.concatenate([e_src + e_tgt[:, None], e_self[:, None]],
                             axis=1),
             negative_slope=self.negative_slope,
         )                                                    # [T, k+1, H]
