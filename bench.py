@@ -74,7 +74,7 @@ def bench_sampling(indptr, indices, batch_size, sizes, iters, warmup=3):
     probe_b = min(256, batch_size)
     probe_seeds = rng.integers(0, n, probe_b).astype(np.int32)
     best_mode, best_dt = None, float("inf")
-    for gm in ("lanes", "xla"):
+    for gm in ("lanes", "lanes_fused", "xla"):
         import jax as _jax
 
         s = GraphSageSampler(topo, sizes, gather_mode=gm)

@@ -43,13 +43,14 @@ def _gather(table: jax.Array, idx: jax.Array, mode: str) -> jax.Array:
     row-gather + lane-select path (``ops.fastgather``) that sidesteps XLA's
     serialized 1-D scalar gather on TPU.  Requires the table to be padded
     to a multiple of 128 (``CSRTopo.to_device`` guarantees it)."""
-    if mode == "lanes":
+    if mode in ("lanes", "lanes_fused"):
         from .fastgather import element_gather
 
         m = table.shape[0] // 128 * 128
         return element_gather(
             table[:m].reshape(-1, 128),
             jnp.clip(idx, 0, m - 1),
+            fused=(mode == "lanes_fused"),
         )
     return jnp.take(table, idx, mode="clip")
 

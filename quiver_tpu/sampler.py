@@ -190,7 +190,7 @@ class GraphSageSampler:
         if mode in ("UVA", "GPU"):  # compat aliases from the reference API
             mode = "TPU"
         assert dedup in ("none", "hop"), dedup
-        assert gather_mode in ("auto", "xla", "lanes"), gather_mode
+        assert gather_mode in ("auto", "xla", "lanes", "lanes_fused"), gather_mode
         if gather_mode == "auto":
             # the lane-select gather pays off where XLA serializes 1-D
             # scalar gathers (TPU); plain take is better on CPU
