@@ -1,0 +1,36 @@
+"""Smoke-run every example at tiny scale — keeps examples working as the
+library evolves (the reference's examples rotted; SURVEY §4)."""
+
+import runpy
+import sys
+
+import numpy as np
+import pytest
+
+EXAMPLES = {
+    "examples/reddit_sage.py": [
+        "--synthetic-nodes", "2000", "--epochs", "1",
+        "--batch-size", "128", "--cache", "5M",
+    ],
+    "examples/graph_sage_unsup.py": [
+        "--nodes", "1500", "--steps", "6", "--batch-size", "64",
+    ],
+    "examples/papers100M_dist.py": [
+        "--nodes", "3000", "--edges", "30000", "--steps", "2",
+        "--batch-size", "8", "--dim", "8",
+    ],
+    "examples/mag240m_rgat.py": [
+        "--papers", "800", "--authors", "400", "--institutions", "50",
+        "--steps", "3", "--batch-size", "16",
+    ],
+    "examples/serving_reddit.py": [
+        "--nodes", "1500", "--edges", "15000", "--clients", "2",
+        "--requests-per-client", "4",
+    ],
+}
+
+
+@pytest.mark.parametrize("script", sorted(EXAMPLES))
+def test_example_runs(script, monkeypatch):
+    monkeypatch.setattr(sys, "argv", [script] + EXAMPLES[script])
+    runpy.run_path(f"/root/repo/{script}", run_name="__main__")

@@ -98,3 +98,19 @@ def test_prob_ordered_cache(small_graph, rng):
     assert prob[hot_old].min() >= prob[cold_old].max()
     ids = rng.integers(0, n, 64)
     np.testing.assert_allclose(np.asarray(f[ids]), full[ids], rtol=1e-6)
+
+
+def test_bf16_cache(small_graph, rng):
+    """bf16 hot tier halves HBM per row; gather returns bf16."""
+    import jax.numpy as jnp
+
+    n = small_graph.node_count
+    full = rng.normal(size=(n, 8)).astype(np.float32)
+    f = Feature(device_cache_size="1G",
+                dtype=jnp.bfloat16).from_cpu_tensor(full)
+    assert f.cache_count == n
+    out = f[np.arange(16)]
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(out, dtype=np.float32), full[:16], atol=0.05, rtol=0.05
+    )
