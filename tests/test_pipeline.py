@@ -96,3 +96,36 @@ def test_scan_epoch(setup):
     # a second epoch continues to improve
     state, losses2 = epoch(state, seeds, labels, jax.random.PRNGKey(6))
     assert float(losses2.mean()) < float(losses.mean())
+
+
+def test_fused_step_with_ici_sharded_feature(setup):
+    """Fused pipeline over an ici_shard (p2p-clique-equivalent) feature:
+    XLA inserts the cross-device gather collectives automatically."""
+    import optax
+
+    from quiver_tpu.utils.mesh import make_mesh
+
+    topo, _, sampler, model, comm = setup
+    mesh = make_mesh(("data",))
+    rng = np.random.default_rng(2)
+    feat = rng.normal(size=(topo.node_count, 8)).astype(np.float32)
+    feature = Feature(device_cache_size="1G",
+                      cache_policy="p2p_clique_replicate",
+                      mesh=mesh).from_cpu_tensor(feat)
+    assert feature.cache_count == topo.node_count
+    tx = optax.adam(1e-2)
+    B = 32
+    b0 = sampler.sample(np.arange(B, dtype=np.int64))
+    params = model.init(jax.random.PRNGKey(0), feature[b0.n_id], b0.layers)
+    state = TrainState.create(params, tx)
+    step = make_fused_train_step(
+        sampler, feature,
+        lambda p, x, blocks, train=False, rngs=None: model.apply(
+            p, x, blocks, train=train, rngs=rngs
+        ), tx,
+    )
+    seeds = jnp.asarray(rng.integers(0, topo.node_count, B), jnp.int32)
+    labels = jnp.asarray(np.asarray(comm)[np.asarray(seeds)])
+    state, loss = step(state, seeds, labels, jnp.ones((B,), bool),
+                       jax.random.PRNGKey(1))
+    assert np.isfinite(float(loss))
