@@ -19,6 +19,7 @@ Public API parity map (reference ``srcs/python/quiver/__init__.py:1-21``):
   RequestBatcher/HybridSampler/InferenceServer -> quiver_tpu.serving
 """
 
+from . import config
 from .utils.topology import CSRTopo, coo_to_csr, parse_size, reindex_feature
 from .utils.mesh import MeshTopo, make_mesh
 from .sampler import GraphSageSampler, SampledBatch, LayerBlock

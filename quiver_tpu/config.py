@@ -1,0 +1,70 @@
+"""Unified runtime configuration.
+
+The reference has no config system — build-time env vars, constructor
+kwargs, and hardcoded constants (SURVEY §5).  Here one small object holds
+the library-wide defaults, overridable by env (``QUIVER_TPU_*``) or
+programmatically (``quiver_tpu.config.update(...)``); constructors still
+take explicit kwargs which always win.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Optional, Tuple
+
+__all__ = ["Config", "get_config", "update"]
+
+
+def _env(name: str, default, cast=str):
+    v = os.environ.get(f"QUIVER_TPU_{name}")
+    if v is None:
+        return default
+    if cast is bool:
+        return v not in ("0", "", "false", "False")
+    return cast(v)
+
+
+@dataclass
+class Config:
+    # sampler
+    gather_mode: str = field(
+        default_factory=lambda: _env("GATHER_MODE", "auto")
+    )
+    dedup: str = field(default_factory=lambda: _env("DEDUP", "none"))
+    # feature store
+    cache_policy: str = field(
+        default_factory=lambda: _env("CACHE_POLICY", "device_replicate")
+    )
+    # serving
+    serving_buckets: Tuple[int, ...] = (
+        8, 16, 32, 64, 128, 256, 512, 1024, 2048
+    )
+    max_coalesce: int = field(
+        default_factory=lambda: _env("MAX_COALESCE", 8, int)
+    )
+    # tracing
+    trace: bool = field(default_factory=lambda: _env("TRACE", False, bool))
+
+
+_config: Optional[Config] = None
+
+
+def get_config() -> Config:
+    global _config
+    if _config is None:
+        _config = Config()
+        if _config.trace:
+            from .utils import trace as _t
+
+            _t.set_enabled(True)
+    return _config
+
+
+def update(**kwargs) -> Config:
+    cfg = get_config()
+    for k, v in kwargs.items():
+        if not hasattr(cfg, k):
+            raise AttributeError(f"unknown config field {k!r}")
+        setattr(cfg, k, v)
+    return cfg

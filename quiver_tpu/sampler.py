@@ -192,11 +192,18 @@ class GraphSageSampler:
         assert dedup in ("none", "hop"), dedup
         assert gather_mode in ("auto", "xla", "lanes", "lanes_fused"), gather_mode
         if gather_mode == "auto":
-            # the lane-select gather pays off where XLA serializes 1-D
-            # scalar gathers (TPU); plain take is better on CPU
-            gather_mode = (
-                "lanes" if jax.default_backend() not in ("cpu",) else "xla"
-            )
+            from .config import get_config
+
+            cfg_mode = get_config().gather_mode
+            if cfg_mode != "auto":
+                gather_mode = cfg_mode
+            else:
+                # the lane-select gather pays off where XLA serializes 1-D
+                # scalar gathers (TPU); plain take is better on CPU
+                gather_mode = (
+                    "lanes" if jax.default_backend() not in ("cpu",)
+                    else "xla"
+                )
         self.gather_mode = gather_mode
         self.csr_topo = csr_topo
         self.sizes = list(sizes)

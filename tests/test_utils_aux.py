@@ -107,3 +107,19 @@ def test_mp_reductions_roundtrip(small_graph, rng):
     s2 = pickle.loads(buf.getvalue())
     b = s2.sample(np.arange(8))
     assert b.batch_size == 8
+
+
+def test_config_env_and_update(monkeypatch):
+    import quiver_tpu.config as cfg_mod
+
+    monkeypatch.setattr(cfg_mod, "_config", None)
+    monkeypatch.setenv("QUIVER_TPU_GATHER_MODE", "xla")
+    c = cfg_mod.get_config()
+    assert c.gather_mode == "xla"
+    cfg_mod.update(gather_mode="lanes")
+    assert cfg_mod.get_config().gather_mode == "lanes"
+    import pytest as _pytest
+
+    with _pytest.raises(AttributeError):
+        cfg_mod.update(nope=1)
+    monkeypatch.setattr(cfg_mod, "_config", None)
