@@ -153,6 +153,22 @@ def main():
         if total:
             print(f"  val acc (sampled): {correct / total:.4f}")
 
+    # exact layer-wise inference for the final score (parity with the
+    # reference's full-graph eval) — feasible when features fit HBM
+    if feature.cache_count >= feature.node_count:
+        from quiver_tpu.models import full_graph_inference
+
+        x_full = feature.hot
+        if feature.feature_order is not None:
+            # hot rows are cache-ordered; inference needs old-id order
+            x_full = x_full[jnp.asarray(feature.feature_order)]
+        logits = full_graph_inference(
+            state.params, x_full, topo.indptr, topo.indices, 3
+        )
+        pred = np.asarray(jnp.argmax(logits, -1))
+        acc = (pred[valid_idx] == labels[valid_idx]).mean()
+        print(f"full-graph val acc: {acc:.4f}")
+
 
 if __name__ == "__main__":
     main()

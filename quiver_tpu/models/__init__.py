@@ -1,5 +1,5 @@
 from .layers import SAGEConv, GATConv
-from .sage import GraphSAGE
+from .sage import GraphSAGE, full_graph_inference
 from .gat import GAT
 from .rgat import RGAT
 from .gcn import GCN, GCNConv
