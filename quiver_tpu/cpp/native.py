@@ -9,7 +9,6 @@ the package never hard-fails.  Parity target: ``CPUQuiver``
 from __future__ import annotations
 
 import ctypes
-import os
 import subprocess
 import threading
 from pathlib import Path

@@ -13,12 +13,10 @@ hosts).  ``getNcclId``-style bootstrap is ``jax.distributed.initialize``.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Optional
 
 import jax
-import jax.numpy as jnp
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 try:
     from jax import shard_map  # jax >= 0.8

@@ -17,7 +17,6 @@ shard" is rows ``[p*max_local, (p+1)*max_local)`` — device-local on p.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Optional
 
 import numpy as np

@@ -13,7 +13,7 @@ NCCL-tier split the reference hand-builds).
 
 from __future__ import annotations
 
-from typing import Optional, Sequence, Tuple
+from typing import Optional
 
 import numpy as np
 

@@ -16,8 +16,6 @@ resolves ``ids in [base, base+rows)`` locally and accumulates.
 
 from __future__ import annotations
 
-from typing import Optional
-
 import numpy as np
 import jax
 import jax.numpy as jnp

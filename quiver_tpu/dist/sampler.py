@@ -16,8 +16,6 @@ the degenerate n=1 case (no collectives emitted).
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
-
 import numpy as np
 import jax
 import jax.numpy as jnp

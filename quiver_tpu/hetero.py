@@ -14,7 +14,7 @@ message flow src -> dst).
 
 from __future__ import annotations
 
-from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
+from typing import Dict, List, Tuple
 
 import numpy as np
 import jax

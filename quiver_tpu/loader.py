@@ -11,7 +11,7 @@ accelerator (``parallel.Prefetcher``).
 
 from __future__ import annotations
 
-from typing import Callable, Iterator, Optional
+from typing import Iterator
 
 import numpy as np
 

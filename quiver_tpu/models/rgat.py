@@ -10,7 +10,7 @@ transform.
 
 from __future__ import annotations
 
-from typing import Dict, Sequence, Tuple
+from typing import Dict
 
 import flax.linen as nn
 import jax

@@ -8,7 +8,7 @@ products test acc ≈ 0.787 per that file's header).
 
 from __future__ import annotations
 
-from typing import Sequence, Tuple
+from typing import Tuple
 
 import flax.linen as nn
 import jax

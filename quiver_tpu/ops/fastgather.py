@@ -16,8 +16,6 @@ kernels get from warp-wide loads (``cuda_random.cu.hpp:8-69``).
 
 from __future__ import annotations
 
-import functools
-
 import jax
 import jax.numpy as jnp
 

@@ -15,7 +15,6 @@ balancing partition sizes.
 from __future__ import annotations
 
 import os
-import pickle
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as np

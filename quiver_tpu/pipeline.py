@@ -20,7 +20,7 @@ import jax.numpy as jnp
 import optax
 
 from .feature import Feature
-from .sampler import GraphSageSampler, _sample_pipeline_nodedup, SampledBatch
+from .sampler import GraphSageSampler, _sample_pipeline_nodedup
 from .parallel.train import TrainState
 
 __all__ = ["make_fused_train_step", "make_fused_eval_fn"]

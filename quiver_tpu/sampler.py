@@ -22,7 +22,7 @@ sit far below the no-dedup bound, so a cap ~2x the typical frontier loses
 
 from __future__ import annotations
 
-from typing import List, NamedTuple, Optional, Sequence, Tuple
+from typing import NamedTuple, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp

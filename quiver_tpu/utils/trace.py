@@ -18,7 +18,7 @@ import os
 import threading
 import time
 from collections import defaultdict
-from typing import Dict, Optional
+from typing import Dict
 
 __all__ = ["trace_scope", "Timer", "trace_summary", "reset_trace",
            "show_tensor_info", "profile_trace"]
