@@ -29,7 +29,7 @@ import numpy as np
 
 __all__ = [
     "RequestBatcher", "HybridSampler", "InferenceServer",
-    "InferenceServer_Debug", "ServingRequest",
+    "InferenceServer_Debug", "ServingRequest", "calibrate_threshold",
 ]
 
 _STOP = object()
@@ -295,6 +295,46 @@ class InferenceServer:
             self.cpu_q.put(_STOP)
         for t in self._threads:
             t.join(timeout=10)
+
+
+def calibrate_threshold(tpu_sampler, cpu_sampler, feature, apply_fn, params,
+                        neighbour_num: np.ndarray, node_count: int,
+                        trials: int = 8, sizes=(1, 4, 16, 64),
+                        seed: int = 0) -> float:
+    """Measure both lanes and return the ``neighbour_num``-sum threshold
+    below which the CPU lane is faster.
+
+    This automates what the reference's ``Preparation`` mode collects
+    manually (serving.py:60-70 duplicates traffic to both lanes so an
+    operator can pick a threshold).  Returns a load value usable directly
+    as ``RequestBatcher(threshold=...)``.
+    """
+    import time as _time
+
+    rng = np.random.default_rng(seed)
+    points = []  # (load, cpu_dt, tpu_dt)
+    for sz in sizes:
+        for _ in range(trials):
+            ids = rng.integers(0, node_count, sz)
+            load = float(neighbour_num[ids].sum())
+            t0 = _time.perf_counter()
+            b = cpu_sampler.sample(ids)
+            x = feature[np.asarray(b.n_id)]
+            np.asarray(apply_fn(params, x, b.layers))
+            cpu_dt = _time.perf_counter() - t0
+            t0 = _time.perf_counter()
+            b = tpu_sampler.sample(ids)
+            x = feature[np.asarray(b.n_id)]
+            np.asarray(apply_fn(params, x, b.layers))
+            tpu_dt = _time.perf_counter() - t0
+            points.append((load, cpu_dt, tpu_dt))
+    points.sort()
+    # largest load where CPU still wins (prefix majority)
+    best = 0.0
+    for load, cpu_dt, tpu_dt in points:
+        if cpu_dt <= tpu_dt:
+            best = load
+    return best
 
 
 class InferenceServer_Debug(InferenceServer):

@@ -168,3 +168,23 @@ def test_device_lane_coalesces(small_graph, rng):
     assert forwards["n"] < len(sizes)  # coalescing happened
     for i, s in enumerate(sizes):
         assert got[i].shape == (s, 2)
+
+
+def test_calibrate_threshold(small_graph, rng):
+    from quiver_tpu.serving import calibrate_threshold
+    from quiver_tpu import generate_neighbour_num
+
+    n = small_graph.node_count
+    feat = rng.normal(size=(n, 4)).astype(np.float32)
+    feature = Feature(device_cache_size="1G").from_cpu_tensor(feat)
+    tpu_s = GraphSageSampler(small_graph, [3])
+    cpu_s = GraphSageSampler(small_graph, [3], mode="CPU")
+    model = GraphSAGE(hidden=8, out_dim=2, num_layers=1, dropout=0.0)
+    b0 = tpu_s.sample(np.arange(4, dtype=np.int64))
+    params = model.init(jax.random.PRNGKey(0),
+                        feature[np.asarray(b0.n_id)], b0.layers)
+    apply_fn = jax.jit(lambda p, x, blocks: model.apply(p, x, blocks))
+    nn_num = generate_neighbour_num(small_graph, [3], mode="expected")
+    thr = calibrate_threshold(tpu_s, cpu_s, feature, apply_fn, params,
+                              nn_num, n, trials=2, sizes=(1, 8))
+    assert thr >= 0.0
