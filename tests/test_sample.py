@@ -95,3 +95,28 @@ def test_to_ragged_matches_reference_contract(small_graph):
         assert got == nbrs[b][mask[b]].tolist()
         off += counts[b]
     assert off == len(flat)
+
+
+def test_cal_neighbor_prob_exact():
+    """Access-probability recurrence against hand-computed expectation."""
+    import jax.numpy as jnp
+
+    from quiver_tpu.ops.prob import cal_neighbor_prob
+
+    # graph: 0 -> {1, 2}, 1 -> {2}, 2 -> {}
+    indptr = jnp.asarray(np.array([0, 2, 3, 3], dtype=np.int32))
+    indices = jnp.asarray(np.array([1, 2, 2], dtype=np.int32))
+    last = jnp.asarray(np.array([1.0, 0.0, 0.0], dtype=np.float32))
+    # k=1: node0 contributes 1 * min(1, 1/2) = 0.5 to each of 1, 2
+    out = np.asarray(cal_neighbor_prob(indptr, indices, last, 1,
+                                       num_edges=3))
+    np.testing.assert_allclose(out, [0.0, 0.5, 0.5], rtol=1e-6)
+    # k=2: node0 contributes min(1, 2/2)=1 to each neighbor
+    out = np.asarray(cal_neighbor_prob(indptr, indices, last, 2,
+                                       num_edges=3))
+    np.testing.assert_allclose(out, [0.0, 1.0, 1.0], rtol=1e-6)
+    # second layer from node1: k=1, deg=1 -> full weight to node2
+    last2 = jnp.asarray(np.array([0.0, 1.0, 0.0], dtype=np.float32))
+    out = np.asarray(cal_neighbor_prob(indptr, indices, last2, 1,
+                                       num_edges=3))
+    np.testing.assert_allclose(out, [0.0, 0.0, 1.0], rtol=1e-6)
