@@ -63,6 +63,7 @@ class SampledBatch(NamedTuple):
         Each Adj is ``(edge_index[2, e], e_id(empty), (n_src, n_dst))``.
         """
         adjs = []
+        n_src = int(self.n_id.shape[0])
         for blk in self.layers:
             m = np.asarray(blk.mask)
             nbr = np.asarray(blk.nbr_local)
@@ -72,13 +73,12 @@ class SampledBatch(NamedTuple):
             e = m.reshape(-1)
             edge_index = np.stack([col.reshape(-1)[e], row.reshape(-1)[e]])
             adjs.append(
-                (edge_index, np.empty(0), (int(self.num_nodes), int(blk.num_targets)))
+                (edge_index, np.empty(0), (n_src, int(blk.num_targets)))
             )
-        return (
-            np.asarray(self.n_id)[: int(self.num_nodes)],
-            self.batch_size,
-            adjs,
-        )
+        # NOTE: local ids index the PADDED frontier (valid entries are not
+        # a contiguous prefix in dedup='none' mode), so n_id is returned
+        # in full; masked slots hold 0 and are referenced by no edge.
+        return (np.asarray(self.n_id), self.batch_size, adjs)
 
 
 def _sample_pipeline_nodedup(indptr, indices, seeds, key, sizes,

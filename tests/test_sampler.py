@@ -77,8 +77,14 @@ def test_pyg_adjs_view(small_graph):
     edge_index, _, size = adjs[-1]
     assert size[1] == 8
     assert edge_index.shape[0] == 2
-    # all local ids in range
-    assert edge_index.max() < int(batch.num_nodes)
+    # all local ids in range of the (padded) frontier, and every edge
+    # resolves to a true graph edge
+    assert edge_index.max() < len(n_id)
+    topo = small_graph
+    for src_l, dst_l in edge_index.T[:50]:
+        tgt, src = n_id[dst_l], n_id[src_l]
+        row = topo.indices[topo.indptr[tgt]: topo.indptr[tgt + 1]]
+        assert src in row
 
 
 def test_frontier_caps(small_graph):
