@@ -36,6 +36,7 @@ class SampleOut(NamedTuple):
     nbrs: jax.Array   # [B, k] int32 global neighbor ids (garbage where ~mask)
     mask: jax.Array   # [B, k] bool
     counts: jax.Array  # [B] int32 = min(degree, k), 0 for invalid seeds
+    eid: Optional[jax.Array] = None  # [B, k] int32 global edge positions
 
 
 def _gather(table: jax.Array, idx: jax.Array, mode: str) -> jax.Array:
@@ -104,7 +105,12 @@ def sample_neighbors(
     idx = start[:, None] + pos
     nbrs = _gather(indices, idx, gather_mode)
     nbrs = jnp.where(mask, nbrs, jnp.int32(-1))
-    return SampleOut(nbrs=nbrs, mask=mask, counts=counts)
+    # global edge positions of the draws: index into CSRTopo.eid / edge-
+    # feature arrays.  The reference's CSR carries edge ids for the same
+    # purpose (quiver.cu.hpp eid); PyG's Adj e_id slot can be filled from
+    # this instead of the reference's empty tensor (sage_sampler.py:143).
+    eid = jnp.where(mask, idx, jnp.int32(-1))
+    return SampleOut(nbrs=nbrs, mask=mask, counts=counts, eid=eid)
 
 
 @functools.partial(jax.jit, static_argnames=("k", "bits"))

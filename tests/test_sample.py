@@ -120,3 +120,23 @@ def test_cal_neighbor_prob_exact():
     out = np.asarray(cal_neighbor_prob(indptr, indices, last2, 1,
                                        num_edges=3))
     np.testing.assert_allclose(out, [0.0, 0.0, 1.0], rtol=1e-6)
+
+
+def test_sample_returns_valid_eids(small_graph):
+    """eid[b,j] indexes the CSR edge array at the sampled position."""
+    indptr, indices = small_graph.to_device()
+    seeds = jnp.asarray(np.arange(12, dtype=np.int32))
+    out = sample_neighbors(indptr, indices, seeds, 4, jax.random.PRNGKey(1))
+    eid = np.asarray(out.eid)
+    nbrs = np.asarray(out.nbrs)
+    mask = np.asarray(out.mask)
+    E = small_graph.edge_count
+    for b in range(12):
+        for j in range(4):
+            if mask[b, j]:
+                assert 0 <= eid[b, j] < E
+                assert small_graph.indices[eid[b, j]] == nbrs[b, j]
+                assert (small_graph.indptr[b] <= eid[b, j]
+                        < small_graph.indptr[b + 1])
+            else:
+                assert eid[b, j] == -1
