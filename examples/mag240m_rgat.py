@@ -50,12 +50,13 @@ def main():
         counts,
     )
     dims = {"paper": args.dim, "author": args.dim // 2, "institution": 16}
-    feats = {
-        t: Feature(device_cache_size="10G").from_cpu_tensor(
-            rng.normal(size=(counts[t], dims[t])).astype(np.float32)
-        )
-        for t in counts
-    }
+    from quiver_tpu import HeteroFeature
+
+    feats = HeteroFeature.from_cpu_tensors(
+        {t: rng.normal(size=(counts[t], dims[t])).astype(np.float32)
+         for t in counts},
+        device_cache_size="10G",
+    )
     labels = rng.integers(0, args.classes, args.papers)
 
     sampler = HeteroGraphSageSampler(
@@ -70,13 +71,7 @@ def main():
     tx = optax.adam(1e-3)
     B = args.batch_size
 
-    def fetch(batch):
-        return {
-            t: feats[t][np.asarray(batch.n_id[t])]
-            if batch.n_id[t].shape[0] else
-            jnp.zeros((0, dims[t]), jnp.float32)
-            for t in counts
-        }
+    fetch = feats.lookup
 
     b0 = sampler.sample(np.arange(B), key=jax.random.PRNGKey(0))
     params = model.init(jax.random.PRNGKey(1), fetch(b0), b0)

@@ -42,6 +42,7 @@ from .hetero import (
     HeteroGraphSageSampler,
     HeteroSampledBatch,
     HeteroLayerBlock,
+    HeteroFeature,
 )
 from .neighbour_num import generate_neighbour_num
 from . import multiprocessing  # registers mp reducers (parity: P10)
@@ -60,7 +61,7 @@ __all__ = [
     "GraphSageSampler", "SampledBatch", "LayerBlock", "SeedLoader", "make_fused_train_step", "make_fused_eval_fn",
     "MixedGraphSageSampler", "SampleJob",
     "HeteroCSRTopo", "HeteroGraphSageSampler", "HeteroSampledBatch",
-    "HeteroLayerBlock",
+    "HeteroLayerBlock", "HeteroFeature",
     "Feature", "DeviceConfig",
     "DistFeature", "PartitionInfo", "TpuComm", "DistGraphSampler",
     "RingFeature", "distributed_initialize", "make_hybrid_mesh",

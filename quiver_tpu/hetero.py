@@ -25,7 +25,7 @@ from .ops.sample import sample_neighbors
 from .utils.topology import CSRTopo
 
 __all__ = ["HeteroCSRTopo", "HeteroGraphSageSampler", "HeteroLayerBlock",
-           "HeteroSampledBatch"]
+           "HeteroSampledBatch", "HeteroFeature"]
 
 Relation = Tuple[str, str, str]
 
@@ -84,6 +84,43 @@ class HeteroCSRTopo:
         for topo in self.relations.values():
             topo.to_device(device)
         return self
+
+
+class HeteroFeature:
+    """Per-node-type feature stores with one batch-level lookup.
+
+    Thin dict-of-:class:`quiver_tpu.Feature` with the ergonomics the
+    hetero pipeline needs: ``hf.lookup(batch)`` returns the feature dict
+    for every type's (padded) frontier, empty types included.
+    """
+
+    def __init__(self, features: Dict[str, "Feature"]):
+        self.features = dict(features)
+
+    @classmethod
+    def from_cpu_tensors(cls, tensors: Dict[str, np.ndarray],
+                         device_cache_size="1G", **kwargs):
+        from .feature import Feature
+
+        return cls({
+            t: Feature(device_cache_size=device_cache_size,
+                       **kwargs).from_cpu_tensor(x)
+            for t, x in tensors.items()
+        })
+
+    def __getitem__(self, key):
+        node_type, ids = key
+        return self.features[node_type][ids]
+
+    def lookup(self, batch: "HeteroSampledBatch") -> Dict[str, jax.Array]:
+        out = {}
+        for t, f in self.features.items():
+            n_id = batch.n_id.get(t)
+            if n_id is None or n_id.shape[0] == 0:
+                out[t] = jnp.zeros((0, f.dim), jnp.float32)
+            else:
+                out[t] = f[np.asarray(n_id)]
+        return out
 
 
 class HeteroGraphSageSampler:

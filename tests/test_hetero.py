@@ -133,3 +133,24 @@ def test_rgat_trains(mag_topo, rng):
         upd, opt = tx.update(g, opt, params)
         params = optax.apply_updates(params, upd)
     assert float(loss_fn(params)) < float(l0)
+
+
+def test_hetero_feature_lookup(mag_topo, rng):
+    from quiver_tpu import HeteroFeature
+
+    topo, _ = mag_topo
+    dims = {"paper": 8, "author": 4, "institution": 2}
+    tensors = {t: rng.normal(size=(n, dims[t])).astype(np.float32)
+               for t, n in topo.node_counts.items()}
+    hf = HeteroFeature.from_cpu_tensors(tensors)
+    s = HeteroGraphSageSampler(topo, sizes=3, num_hops=1, seed_type="paper")
+    b = s.sample(np.arange(8), key=jax.random.PRNGKey(0))
+    xs = hf.lookup(b)
+    for t in dims:
+        assert xs[t].shape == (b.n_id[t].shape[0], dims[t]) or (
+            xs[t].shape[0] == 0
+        )
+    # values match ground truth for the paper frontier
+    pid = np.asarray(b.n_id["paper"])
+    np.testing.assert_allclose(np.asarray(xs["paper"]),
+                               tensors["paper"][pid], rtol=1e-6)
