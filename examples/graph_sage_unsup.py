@@ -79,8 +79,7 @@ def main():
     print(f"{args.steps} unsup steps in {time.perf_counter() - t0:.2f}s")
 
     # probe: do embeddings separate communities? (cosine sim intra vs inter)
-    probe = rng.integers(0, topo.nodes if hasattr(topo, 'nodes')
-                         else topo.node_count, 3 * B)
+    probe = rng.integers(0, topo.node_count, 3 * B)
     pb = sampler.sample(probe, key=jax.random.PRNGKey(99))
     z = np.asarray(model.apply(params, feature[np.asarray(pb.n_id)],
                                pb.layers))

@@ -166,7 +166,6 @@ class HeteroGraphSageSampler:
             jnp.ones((seeds.shape[0],), bool),
         )
         all_layers = []
-        kidx = 0
         for hop, hop_size in enumerate(self.hop_sizes):
             blocks = []
             # snapshot: sample for the frontier as it stood at hop start
