@@ -14,9 +14,13 @@ via :func:`to_ragged` for API parity.
 Without-replacement sampling: the CUDA kernel does reservoir sampling.  On
 TPU we use **stratified positions** — neighbor slot ``j`` draws uniformly
 from window ``[floor(j*deg/k), floor((j+1)*deg/k))``.  For ``deg > k`` the
-windows are disjoint and non-empty, so the k draws are distinct; the
-per-element inclusion probability is ``k/deg``, matching reservoir marginals.
-No hash table, no atomics, no sequential loop.
+windows are disjoint and non-empty, so the k draws are distinct.  Marginals:
+an element's inclusion probability is ``1/|window|`` with window sizes
+``floor(deg/k)`` or ``ceil(deg/k)`` — exactly ``k/deg`` when ``k | deg``,
+within a ``±k/deg`` relative factor otherwise (vs exact-uniform reservoir);
+CSR neighbor order is arbitrary, so the tiny position-correlated bias has
+no graph-semantic alignment.  No hash table, no atomics, no sequential
+loop.
 """
 
 from __future__ import annotations
