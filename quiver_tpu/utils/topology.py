@@ -139,9 +139,13 @@ class CSRTopo:
                 )
 
             def pad128(a):
-                pad = (-len(a)) % 128
-                if pad:
-                    a = np.concatenate([a, np.zeros(pad, a.dtype)])
+                # multiple of 128, and never empty (edge-less graphs must
+                # still produce a gatherable device array)
+                target = max(((len(a) + 127) // 128) * 128, 128)
+                if target != len(a):
+                    a = np.concatenate(
+                        [a, np.zeros(target - len(a), a.dtype)]
+                    )
                 return a
 
             indptr = jnp.asarray(pad128(self.indptr_.astype(np.int32)))

@@ -1,0 +1,94 @@
+"""Invariant fuzzing over degenerate and random graphs.
+
+Edge cases the reference's suite never covered: isolated nodes everywhere,
+single-node graphs, star hubs, empty-ish CSRs — every one must keep the
+sampler invariants (masks consistent, edges real, shapes static).
+"""
+
+import numpy as np
+import jax
+import pytest
+
+from quiver_tpu import CSRTopo, GraphSageSampler
+
+
+def _check_invariants(topo, batch, seeds):
+    n_id = np.asarray(batch.n_id)
+    n_mask = np.asarray(batch.n_id_mask)
+    assert n_id.shape == n_mask.shape
+    np.testing.assert_array_equal(n_id[: len(seeds)], seeds)
+    assert n_mask[: len(seeds)].all()
+    for blk in batch.layers:
+        local = np.asarray(blk.nbr_local)
+        m = np.asarray(blk.mask)
+        assert local.shape == m.shape
+        # masked entries point at index 0; valid entries at valid frontier
+        assert (local[~m] == 0).all()
+        if m.any():
+            assert n_mask[local[m]].all()
+        t = local.shape[0]
+        for b in range(min(t, 16)):
+            if not n_mask[b]:
+                assert not m[b].any()
+                continue
+            tgt = n_id[b]
+            row = set(topo.indices[
+                topo.indptr[tgt]: topo.indptr[tgt + 1]].tolist())
+            for j in range(local.shape[1]):
+                if m[b, j]:
+                    assert n_id[local[b, j]] in row
+
+
+def graphs():
+    rng = np.random.default_rng(0)
+    out = {}
+    # all nodes isolated
+    out["isolated"] = CSRTopo(indptr=np.zeros(11, np.int64),
+                              indices=np.zeros(0, np.int32))
+    # single node with self loop
+    out["selfloop"] = CSRTopo(indptr=np.array([0, 1]),
+                              indices=np.array([0], np.int32))
+    # star: node 0 -> everyone
+    n = 50
+    out["star"] = CSRTopo(
+        indptr=np.concatenate([[0], np.full(n - 1, n - 1)]).cumsum()
+        if False else np.concatenate(
+            [[0, n - 1], np.full(n - 1, n - 1)]
+        ).astype(np.int64),
+        indices=np.arange(1, n, dtype=np.int32),
+    )
+    # chain
+    out["chain"] = CSRTopo(
+        indptr=np.arange(0, 21, 1, dtype=np.int64).clip(0, 19),
+        indices=np.arange(1, 20, dtype=np.int32),
+    )
+    # random sparse
+    for i in range(3):
+        nn = int(rng.integers(5, 80))
+        deg = rng.integers(0, 6, nn)
+        src = np.repeat(np.arange(nn), deg)
+        dst = rng.integers(0, nn, len(src))
+        out[f"rand{i}"] = CSRTopo(edge_index=np.stack([src, dst]),
+                                  node_count=nn)
+    return out
+
+
+@pytest.mark.parametrize("name", list(graphs()))
+@pytest.mark.parametrize("dedup", ["none", "hop"])
+def test_fuzz_invariants(name, dedup):
+    topo = graphs()[name]
+    rng = np.random.default_rng(hash(name) % 2**31)
+    s = GraphSageSampler(topo, [3, 2], dedup=dedup)
+    B = min(8, topo.node_count)
+    seeds = rng.integers(0, topo.node_count, B)
+    batch = s.sample(seeds, key=jax.random.PRNGKey(1))
+    _check_invariants(topo, batch, seeds)
+
+
+def test_fuzz_cpu_mode_invariants():
+    for name, topo in graphs().items():
+        s = GraphSageSampler(topo, [3, 2], mode="CPU")
+        B = min(8, topo.node_count)
+        seeds = np.arange(B)
+        batch = s.sample(seeds)
+        _check_invariants(topo, batch, seeds)
