@@ -72,6 +72,9 @@ def main():
     ap.add_argument("--batch-size", type=int, default=1024)
     ap.add_argument("--cache", default="200M",
                     help="device feature-cache budget (quiver.Feature)")
+    ap.add_argument("--dp", action="store_true",
+                    help="data-parallel over all devices (the reference's "
+                         "multi-GPU table: 11.1s -> 3.25s on 1 -> 4 GPUs)")
     args = ap.parse_args()
 
     topo, feat, labels, train_idx, valid_idx, _, n_cls = load_dataset(
@@ -94,6 +97,48 @@ def main():
     x0 = feature[np.asarray(b0.n_id)]
     params = model.init(jax.random.PRNGKey(0), x0, b0.layers)
     state = TrainState.create(params, tx)
+
+    if args.dp:
+        from quiver_tpu.utils.mesh import make_mesh
+
+        mesh = make_mesh(("data",))
+        ndev = int(mesh.shape["data"])
+        dp_step = make_train_step(
+            lambda p, x, blocks, train=False, rngs=None: model.apply(
+                p, x, blocks, train=train, rngs=rngs
+            ),
+            tx, mesh=mesh,
+        )
+        print(f"data-parallel over {ndev} devices")
+        rng = np.random.default_rng(1)
+        for epoch in range(args.epochs):
+            order = rng.permutation(len(train_idx))
+            t0 = time.perf_counter()
+            n_rounds = len(train_idx) // (B * ndev)
+            loss = None
+            for r in range(n_rounds):
+                parts = []
+                for d in range(ndev):
+                    seeds = train_idx[order[(r * ndev + d) * B:
+                                            (r * ndev + d + 1) * B]]
+                    bt = sampler.sample(
+                        seeds, key=jax.random.PRNGKey(r * ndev + d))
+                    parts.append((bt, feature[np.asarray(bt.n_id)],
+                                  jnp.asarray(labels[seeds])))
+                xs = jnp.stack([p[1] for p in parts])
+                blocks = jax.tree_util.tree_map(
+                    lambda *ls: jnp.stack(ls), *[p[0].layers for p in parts]
+                )
+                labs = jnp.stack([p[2] for p in parts])
+                masks = jnp.ones((ndev, B), bool)
+                state, loss = dp_step(state, xs, blocks, labs, masks,
+                                      jax.random.PRNGKey(r))
+            jax.block_until_ready(loss)
+            print(f"epoch {epoch}: {time.perf_counter() - t0:.2f}s "
+                  f"({n_rounds} rounds x {ndev} replicas x {B}), "
+                  f"loss {float(loss):.4f}")
+        return
+
     step = make_train_step(
         lambda p, x, blocks, train=False, rngs=None: model.apply(
             p, x, blocks, train=train, rngs=rngs
