@@ -188,7 +188,7 @@ class InferenceServer:
     # -- core per-request paths ---------------------------------------
     def _pad_ids(self, ids: np.ndarray) -> np.ndarray:
         b = _next_bucket(len(ids), self.BUCKETS)
-        if len(ids) == b:
+        if len(ids) >= b:  # at or above the top bucket: run as-is
             return ids
         return np.concatenate([ids, np.full(b - len(ids), ids[0] if len(ids)
                                             else 0, dtype=ids.dtype)])

@@ -188,3 +188,25 @@ def test_calibrate_threshold(small_graph, rng):
     thr = calibrate_threshold(tpu_s, cpu_s, feature, apply_fn, params,
                               nn_num, n, trials=2, sizes=(1, 8))
     assert thr >= 0.0
+
+
+def test_oversized_request_served(small_graph, rng):
+    """Requests above the top bucket run unpadded instead of crashing."""
+    n = small_graph.node_count
+    feat = rng.normal(size=(n, 4)).astype(np.float32)
+    feature = Feature(device_cache_size="1G").from_cpu_tensor(feat)
+    sampler = GraphSageSampler(small_graph, [3])
+    model = GraphSAGE(hidden=8, out_dim=2, num_layers=1, dropout=0.0)
+    b0 = sampler.sample(np.arange(8, dtype=np.int64))
+    params = model.init(jax.random.PRNGKey(0),
+                        feature[np.asarray(b0.n_id)], b0.layers)
+    apply_fn = lambda p, x, blocks: model.apply(p, x, blocks)
+    dq = queue.Queue()
+    server = InferenceServer(sampler, feature, apply_fn, params, dq,
+                             max_coalesce=1).start()
+    big = rng.integers(0, n, InferenceServer.BUCKETS[-1] + 100)
+    dq.put(ServingRequest(ids=big, client=0, seq=0))
+    req, out = server.result_queue.get(timeout=120)
+    server.stop()
+    assert not isinstance(out, Exception), out
+    assert out.shape == (len(big), 2)
