@@ -143,3 +143,41 @@ def test_full_graph_inference_matches_manual(small_graph, rng):
         if i != 1:
             h = np.maximum(h, 0)
     np.testing.assert_allclose(out, h, rtol=2e-4, atol=2e-5)
+
+
+def test_gatconv_matches_manual(small_graph, rng):
+    """GATConv (1 head) equals a hand-computed masked-softmax attention
+    with the self-loop term a_src·Wx_i + a_tgt·Wx_i."""
+    from quiver_tpu.models import GATConv
+
+    s = GraphSageSampler(small_graph, [3])
+    seeds = np.arange(6, dtype=np.int64)
+    b = s.sample(seeds, key=jax.random.PRNGKey(8))
+    blk = b.layers[0]
+    x = jnp.asarray(rng.normal(size=(b.n_id.shape[0], 5)), jnp.float32)
+    conv = GATConv(4, heads=1, concat=True)
+    params = conv.init(jax.random.PRNGKey(0), x, blk)
+    out = np.asarray(conv.apply(params, x, blk))
+
+    w = np.asarray(params["params"]["lin"]["kernel"])      # [5, 4]
+    a_s = np.asarray(params["params"]["att_src"])[0]       # [4]
+    a_t = np.asarray(params["params"]["att_tgt"])[0]       # [4]
+    xs = np.asarray(x)
+    local = np.asarray(blk.nbr_local)
+    m = np.asarray(blk.mask)
+
+    def leaky(v):
+        return np.where(v > 0, v, 0.2 * v)
+
+    for i in range(6):
+        wi = xs[i] @ w
+        nbr_ids = local[i][m[i]]
+        wn = xs[nbr_ids] @ w if len(nbr_ids) else np.zeros((0, 4))
+        e = [leaky(wn[j] @ a_s + wi @ a_t) for j in range(len(nbr_ids))]
+        e.append(leaky(wi @ a_s + wi @ a_t))  # self loop
+        e = np.array(e)
+        al = np.exp(e - e.max())
+        al = al / al.sum()
+        vals = np.concatenate([wn, wi[None]], axis=0)
+        ref = (al[:, None] * vals).sum(axis=0)
+        np.testing.assert_allclose(out[i], ref, rtol=1e-4, atol=1e-5)
