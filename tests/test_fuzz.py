@@ -92,3 +92,36 @@ def test_fuzz_cpu_mode_invariants():
         seeds = np.arange(B)
         batch = s.sample(seeds)
         _check_invariants(topo, batch, seeds)
+
+
+@pytest.mark.parametrize("name", ["isolated", "selfloop", "star", "chain"])
+def test_fuzz_weighted(name):
+    """Weighted sampling keeps invariants on degenerate graphs."""
+    import jax.numpy as jnp
+
+    from quiver_tpu.ops.sample import (
+        sample_neighbors_weighted, row_cumsum_weights,
+    )
+
+    topo = graphs()[name]
+    rng = np.random.default_rng(1)
+    w = rng.uniform(0.1, 1.0, topo.edge_count).astype(np.float32)
+    cw = row_cumsum_weights(topo.indptr, w)
+    indptr, indices = topo.to_device()
+    cw_dev = jnp.asarray(np.concatenate(
+        [cw, np.zeros(indices.shape[0] - len(cw), np.float32)]
+    ))
+    B = min(6, topo.node_count)
+    seeds = jnp.asarray(np.arange(B, dtype=np.int32))
+    out = sample_neighbors_weighted(indptr, indices, cw_dev, seeds, 3,
+                                    jax.random.PRNGKey(0))
+    nbrs = np.asarray(out.nbrs)
+    mask = np.asarray(out.mask)
+    deg = topo.degree
+    for b in range(B):
+        assert mask[b].sum() == min(deg[b], 3)
+        row = set(topo.indices[
+            topo.indptr[b]: topo.indptr[b + 1]].tolist())
+        for j in range(3):
+            if mask[b, j]:
+                assert nbrs[b, j] in row
