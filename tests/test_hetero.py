@@ -154,3 +154,41 @@ def test_hetero_feature_lookup(mag_topo, rng):
     pid = np.asarray(b.n_id["paper"])
     np.testing.assert_allclose(np.asarray(xs["paper"]),
                                tensors["paper"][pid], rtol=1e-6)
+
+
+def test_rel_attention_matches_manual(mag_topo, rng):
+    """_RelAttention (1 head) equals hand-computed masked softmax."""
+    from quiver_tpu.models.rgat import _RelAttention
+
+    topo, _ = mag_topo
+    s = HeteroGraphSageSampler(topo, sizes=3, num_hops=1, seed_type="paper")
+    b = s.sample(np.arange(5), key=jax.random.PRNGKey(4))
+    blk = [x for x in b.layers[0]
+           if x.relation == ("author", "writes", "paper")][0]
+    x_src = jnp.asarray(
+        rng.normal(size=(b.n_id["author"].shape[0], 4)), jnp.float32)
+    x_dst = jnp.asarray(
+        rng.normal(size=(b.n_id["paper"].shape[0], 4)), jnp.float32)
+    att = _RelAttention(3, heads=1)
+    params = att.init(jax.random.PRNGKey(0), x_src, x_dst, blk)
+    out = np.asarray(att.apply(params, x_src, x_dst, blk))
+
+    p = params["params"]
+    ws, wd = np.asarray(p["w_src"]["kernel"]), np.asarray(p["w_dst"]["kernel"])
+    a_s, a_d = np.asarray(p["att_src"])[0], np.asarray(p["att_dst"])[0]
+    xs, xd = np.asarray(x_src), np.asarray(x_dst)
+    local, m = np.asarray(blk.nbr_local), np.asarray(blk.mask)
+
+    def leaky(v):
+        return np.where(v > 0, v, 0.2 * v)
+
+    for i in range(min(5, local.shape[0])):
+        if not m[i].any():
+            np.testing.assert_allclose(out[i], 0.0, atol=1e-6)
+            continue
+        wn = xs[local[i][m[i]]] @ ws
+        wdi = xd[i] @ wd
+        e = leaky(wn @ a_s + wdi @ a_d)
+        al = np.exp(e - e.max()); al /= al.sum()
+        ref = (al[:, None] * wn).sum(axis=0)
+        np.testing.assert_allclose(out[i], ref, rtol=1e-4, atol=1e-5)
