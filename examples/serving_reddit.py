@@ -51,6 +51,15 @@ def main():
                         feature[np.asarray(b0.n_id)], b0.layers)
     apply_fn = jax.jit(lambda p, x, blocks: model.apply(p, x, blocks))
 
+    # pre-warm the serving buckets so request latency excludes compiles
+    from quiver_tpu import InferenceServer as _IS
+
+    for bucket in _IS.BUCKETS:
+        if bucket > 32:
+            break
+        bb = tpu_sampler.sample(np.arange(bucket, dtype=np.int64))
+        apply_fn(params, feature[np.asarray(bb.n_id)], bb.layers)
+
     nn_num = generate_neighbour_num(topo, sizes, mode="expected")
     streams = [queue.Queue() for _ in range(args.clients)]
     rb = RequestBatcher(streams, neighbour_num=nn_num,
