@@ -181,3 +181,28 @@ def test_gatconv_matches_manual(small_graph, rng):
         vals = np.concatenate([wn, wi[None]], axis=0)
         ref = (al[:, None] * vals).sum(axis=0)
         np.testing.assert_allclose(out[i], ref, rtol=1e-4, atol=1e-5)
+
+
+def test_gcnconv_matches_manual(small_graph, rng):
+    """GCNConv equals the hand-computed sampled-degree normalization."""
+    from quiver_tpu.models import GCNConv
+
+    s = GraphSageSampler(small_graph, [3])
+    seeds = np.arange(6, dtype=np.int64)
+    b = s.sample(seeds, key=jax.random.PRNGKey(9))
+    blk = b.layers[0]
+    x = jnp.asarray(rng.normal(size=(b.n_id.shape[0], 5)), jnp.float32)
+    conv = GCNConv(4)
+    params = conv.init(jax.random.PRNGKey(0), x, blk)
+    out = np.asarray(conv.apply(params, x, blk))
+
+    w = np.asarray(params["params"]["lin"]["kernel"])
+    bias = np.asarray(params["params"]["lin"]["bias"])
+    xs = np.asarray(x)
+    local, m = np.asarray(blk.nbr_local), np.asarray(blk.mask)
+    for i in range(6):
+        wi = xs[i] @ w + bias
+        wn = xs[local[i][m[i]]] @ w + bias
+        norm = 1.0 / np.sqrt(m[i].sum() + 1.0)
+        ref = (wn.sum(axis=0) * norm + wi) * norm
+        np.testing.assert_allclose(out[i], ref, rtol=1e-4, atol=1e-5)
