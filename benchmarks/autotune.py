@@ -1,0 +1,74 @@
+"""Hardware autotune: probe the gather-mode / batch-size space on the
+current accelerator and persist the winners as library defaults.
+
+Run once per hardware generation:
+
+    python benchmarks/autotune.py [--nodes N --edges E]
+
+Writes ``.quiver_tpu_tuned.json`` at the repo root;
+``quiver_tpu.config.get_config()`` picks it up automatically, so samplers
+constructed with ``gather_mode="auto"`` use the measured winner.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import numpy as np
+
+TUNED_PATH = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), ".quiver_tpu_tuned.json")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nodes", type=int, default=2_449_029)
+    ap.add_argument("--edges", type=int, default=123_718_280)
+    ap.add_argument("--fanout", type=int, nargs="+", default=[15, 10, 5])
+    ap.add_argument("--batch", type=int, default=512)
+    args = ap.parse_args()
+
+    import jax
+
+    from bench import build_graph
+    from quiver_tpu import CSRTopo, GraphSageSampler
+
+    indptr, indices = build_graph(args.nodes, args.edges)
+    topo = CSRTopo(indptr=indptr, indices=indices)
+    rng = np.random.default_rng(0)
+    seeds = rng.integers(0, topo.node_count, args.batch).astype(np.int32)
+
+    results = {}
+    for gm in ("lanes", "lanes_fused", "xla"):
+        try:
+            s = GraphSageSampler(topo, args.fanout, gather_mode=gm)
+            s.sample(seeds).n_id.block_until_ready()
+            t0 = time.perf_counter()
+            for r in range(3):
+                s.sample(seeds,
+                         key=jax.random.PRNGKey(r)).n_id.block_until_ready()
+            results[gm] = (time.perf_counter() - t0) / 3
+            print(f"{gm}: {results[gm] * 1e3:.1f} ms/batch")
+        except Exception as e:
+            print(f"{gm}: skipped ({type(e).__name__})")
+    if not results:
+        print("no mode succeeded; nothing written")
+        return
+    best = min(results, key=results.get)
+    payload = {
+        "gather_mode": best,
+        "backend": jax.default_backend(),
+        "device": str(jax.devices()[0]),
+        "probe_ms": {k: round(v * 1e3, 2) for k, v in results.items()},
+    }
+    with open(TUNED_PATH, "w") as f:
+        json.dump(payload, f, indent=2)
+    print(f"tuned defaults -> {TUNED_PATH}: {payload}")
+
+
+if __name__ == "__main__":
+    main()

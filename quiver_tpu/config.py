@@ -50,10 +50,31 @@ class Config:
 _config: Optional[Config] = None
 
 
+def _load_tuned(cfg: Config):
+    """Fold in hardware-probed defaults (benchmarks/autotune.py), if any.
+    Explicit env vars still win."""
+    import json
+
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        ".quiver_tpu_tuned.json",
+    )
+    if not os.path.exists(path):
+        return
+    try:
+        tuned = json.load(open(path))
+    except Exception:
+        return
+    if (cfg.gather_mode == "auto"
+            and tuned.get("gather_mode") in ("xla", "lanes", "lanes_fused")):
+        cfg.gather_mode = tuned["gather_mode"]
+
+
 def get_config() -> Config:
     global _config
     if _config is None:
         _config = Config()
+        _load_tuned(_config)
         if _config.trace:
             from .utils import trace as _t
 
