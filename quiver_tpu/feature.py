@@ -225,7 +225,13 @@ class Feature:
         import jax
         import jax.numpy as jnp
 
+        from .utils.trace import trace_scope
+
         self.lazy_init_from_ipc_handle()
+        with trace_scope("feature.getitem"):
+            return self._getitem_impl(node_idx, jax, jnp)
+
+    def _getitem_impl(self, node_idx, jax, jnp):
         if self.cache_count >= self.node_count:
             if isinstance(node_idx, jax.Array):
                 return self.lookup_device(node_idx)

@@ -298,7 +298,10 @@ class GraphSageSampler:
         key = key if key is not None else jax.random.PRNGKey(
             np.random.randint(0, 2**31 - 1)
         )
-        n_id, n_mask, num_nodes, blocks = self._jitted[1](seeds, key)
+        from .utils.trace import trace_scope
+
+        with trace_scope("sampler.sample"):
+            n_id, n_mask, num_nodes, blocks = self._jitted[1](seeds, key)
         return SampledBatch(
             n_id=n_id, n_id_mask=n_mask, num_nodes=num_nodes,
             batch_size=B, layers=blocks,
