@@ -173,7 +173,7 @@ class InferenceServer:
                  device_batched_queue: "queue.Queue",
                  cpu_sampled_queue: Optional["queue.Queue"] = None,
                  result_queue: Optional["queue.Queue"] = None,
-                 max_coalesce: int = 8):
+                 max_coalesce: Optional[int] = None):
         self.sampler = tpu_sampler
         self.feature = feature
         self.apply_fn = apply_fn
@@ -181,6 +181,12 @@ class InferenceServer:
         self.device_q = device_batched_queue
         self.cpu_q = cpu_sampled_queue
         self.result_queue = result_queue or queue.Queue()
+        if max_coalesce is None:
+            from .config import get_config
+
+            cfg = get_config()
+            max_coalesce = cfg.max_coalesce
+            self.BUCKETS = tuple(cfg.serving_buckets)
         self.max_coalesce = max_coalesce
         self._threads: List[threading.Thread] = []
         self._stopped = threading.Event()
