@@ -7,6 +7,8 @@ import sys
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 EXAMPLES = {
     "examples/reddit_sage.py": [
         "--synthetic-nodes", "2000", "--epochs", "1",

@@ -11,6 +11,8 @@ import jax.numpy as jnp
 import optax
 import pytest
 
+pytestmark = pytest.mark.slow
+
 from quiver_tpu import CSRTopo, Feature, GraphSageSampler
 from quiver_tpu.models import GraphSAGE
 from quiver_tpu.parallel import TrainState, make_train_step
