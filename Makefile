@@ -1,5 +1,5 @@
 # Top-level convenience targets (parity: reference ./configure && make).
-.PHONY: all native test test-native asan bench smoke
+.PHONY: all native test test-quick test-native asan bench smoke help
 
 all: native
 
@@ -20,3 +20,9 @@ bench:
 
 smoke:
 	python bench.py --small --iters 5
+
+test-quick:
+	python -m pytest tests/ -m "not slow" -q
+
+help:
+	@echo "targets: native | test | test-quick | test-native | asan | bench | smoke"
