@@ -164,3 +164,17 @@ def test_sample_sub(small_graph):
         rowset = small_graph.indices[
             small_graph.indptr[tgt]: small_graph.indptr[tgt + 1]]
         assert src in rowset
+
+
+def test_sampling_is_deterministic_per_key(small_graph):
+    """Same PRNG key -> identical batches across sampler instances
+    (reproducibility across restarts, unlike the reference's stateful
+    curand streams)."""
+    seeds = np.arange(16, dtype=np.int64)
+    key = jax.random.PRNGKey(1234)
+    b1 = GraphSageSampler(small_graph, [4, 3]).sample(seeds, key=key)
+    b2 = GraphSageSampler(small_graph, [4, 3]).sample(seeds, key=key)
+    np.testing.assert_array_equal(np.asarray(b1.n_id), np.asarray(b2.n_id))
+    for l1, l2 in zip(b1.layers, b2.layers):
+        np.testing.assert_array_equal(np.asarray(l1.mask),
+                                      np.asarray(l2.mask))
