@@ -25,6 +25,10 @@ EXAMPLES = {
         "--papers", "800", "--authors", "400", "--institutions", "50",
         "--steps", "3", "--batch-size", "16",
     ],
+    "examples/preprocess_partition.py": [
+        "--nodes", "2000", "--edges", "20000", "--hosts", "4",
+        "--out", "/tmp/qt_part_test",
+    ],
     "examples/serving_reddit.py": [
         "--nodes", "1500", "--edges", "15000", "--clients", "2",
         "--requests-per-client", "4",
