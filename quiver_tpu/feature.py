@@ -309,6 +309,12 @@ class Feature:
         self._lazy_state = ipc_handle
         return self
 
+    def __repr__(self):
+        return (
+            f"Feature(nodes={self.node_count}, dim={self.dim}, "
+            f"hot={self.cache_count}, policy={self.cache_policy!r})"
+        )
+
     def lazy_init_from_ipc_handle(self):
         if self._lazy_state is None:
             return

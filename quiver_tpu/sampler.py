@@ -346,3 +346,10 @@ class GraphSageSampler:
     def lazy_from_ipc_handle(cls, ipc_handle):
         csr_topo, sizes, mode = ipc_handle
         return cls(csr_topo, sizes, mode=mode)
+
+    def __repr__(self):
+        return (
+            f"GraphSageSampler(sizes={self.sizes}, mode={self.mode!r}, "
+            f"dedup={self.dedup!r}, gather={self.gather_mode!r}, "
+            f"graph={self.csr_topo!r})"
+        )
