@@ -15,7 +15,7 @@ import queue
 import threading
 from typing import Callable, Iterable, Iterator
 
-__all__ = ["Prefetcher", "AsyncNeighborSampler"]
+__all__ = ["Prefetcher", "AsyncNeighborSampler", "AsyncCudaNeighborSampler"]
 
 _END = object()
 
@@ -83,3 +83,7 @@ class AsyncNeighborSampler:
 
         jax.block_until_ready(out)
         return out
+
+
+# reference-name alias (P16, ``async_cuda_sampler.py``): same role, no CUDA
+AsyncCudaNeighborSampler = AsyncNeighborSampler
