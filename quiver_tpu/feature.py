@@ -65,7 +65,9 @@ class Feature:
                  device_cache_size: Union[int, str] = 0,
                  cache_policy: str = "device_replicate",
                  csr_topo: Optional[CSRTopo] = None,
-                 mesh=None, dtype=None):
+                 mesh=None, dtype=None, cache_unit: str = "bytes"):
+        assert cache_unit in ("bytes", "rows"), cache_unit
+        self.cache_unit = cache_unit
         if cache_policy == "p2p_clique_replicate":
             cache_policy = "ici_shard"
         assert cache_policy in ("device_replicate", "ici_shard"), cache_policy
@@ -87,7 +89,10 @@ class Feature:
     # ------------------------------------------------------------------
     def _budget_rows(self, row_bytes: int, n_devices: int) -> int:
         budget = parse_size(self.device_cache_size)
-        rows = budget // max(row_bytes, 1)
+        if self.cache_unit == "rows":
+            rows = budget
+        else:
+            rows = budget // max(row_bytes, 1)
         if self.cache_policy == "ici_shard":
             rows *= n_devices  # each device holds 1/n of the hot set
         return int(rows)

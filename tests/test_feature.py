@@ -114,3 +114,13 @@ def test_bf16_cache(small_graph, rng):
     np.testing.assert_allclose(
         np.asarray(out, dtype=np.float32), full[:16], atol=0.05, rtol=0.05
     )
+
+
+def test_cache_unit_rows(small_graph, rng):
+    n = small_graph.node_count
+    full = rng.normal(size=(n, 8)).astype(np.float32)
+    f = Feature(device_cache_size=25,
+                cache_unit="rows").from_cpu_tensor(full)
+    assert f.cache_count == 25
+    ids = rng.integers(0, n, 16)
+    _ground_truth_check(f, full, ids)
