@@ -93,6 +93,15 @@ def bench_sampling(indptr, indices, batch_size, sizes, iters, warmup=3):
         if dt < best_dt:
             best_mode, best_dt = gm, dt
     log(f"selected gather_mode={best_mode}")
+    try:  # persist for future sessions (config auto-loads this)
+        import json as _json
+
+        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               ".quiver_tpu_tuned.json"), "w") as fh:
+            _json.dump({"gather_mode": best_mode,
+                        "backend": jax.default_backend()}, fh)
+    except Exception:
+        pass
     sampler = GraphSageSampler(topo, sizes, gather_mode=best_mode)
     seed_batches = [
         rng.integers(0, n, batch_size).astype(np.int32)
