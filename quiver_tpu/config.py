@@ -65,6 +65,15 @@ def _load_tuned(cfg: Config):
         tuned = json.load(open(path))
     except Exception:
         return
+    # only apply results probed on THIS backend (a cpu-probed choice must
+    # not override the TPU default and vice versa)
+    try:
+        import jax
+
+        if tuned.get("backend") != jax.default_backend():
+            return
+    except Exception:
+        return
     if (cfg.gather_mode == "auto"
             and tuned.get("gather_mode") in ("xla", "lanes", "lanes_fused")):
         cfg.gather_mode = tuned["gather_mode"]
