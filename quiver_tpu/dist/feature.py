@@ -204,18 +204,26 @@ class DistFeature:
             gathered = jnp.take(flat, jnp.clip(dest, 0, n * cap - 1),
                                 axis=0)
             out = jnp.where((valid & ~overflow)[:, None], gathered, 0)
-            return out[None]
+            ocount = (valid & overflow).sum().astype(jnp.int32)
+            return out[None], ocount[None]
 
         f = shard_map(
             body, mesh=self.mesh,
             in_specs=(P(axis, None, None), P(axis, None), P(axis, None)),
-            out_specs=P(axis, None, None),
+            out_specs=(P(axis, None, None), P(axis)),
         )
         return jax.jit(f)
 
     def lookup(self, ids, valid=None):
         """``ids``: [n_hosts, B] int32 (one batch per host).  Returns
-        [n_hosts, B, D] with each host's features resolved."""
+        [n_hosts, B, D] with each host's features resolved.
+
+        After each call ``self.last_overflow`` holds a ``[n_hosts]`` device
+        array counting queries that overflowed their destination bucket and
+        got ZERO feature rows.  Always zero when ``request_cap`` is None
+        (cap = B, the exact worst case); check :meth:`overflow_stats` when
+        running with a reduced cap — training on silently zeroed features
+        is the failure mode this guards against."""
         ids = jnp.asarray(ids, jnp.int32)
         nh, B = ids.shape
         if valid is None:
@@ -227,11 +235,31 @@ class DistFeature:
         sharding = NamedSharding(self.mesh, P(self.axis, None))
         ids = jax.device_put(ids, sharding)
         valid = jax.device_put(valid, sharding)
-        return self._fn[key](self.shards, ids, valid)
+        out, overflow = self._fn[key](self.shards, ids, valid)
+        self.last_overflow = overflow
+        return out
+
+    def overflow_stats(self):
+        """Per-host dropped-query counts from the most recent lookup as a
+        host int array (None before any call)."""
+        if getattr(self, "last_overflow", None) is None:
+            return None
+        return np.asarray(self.last_overflow)
 
     def __getitem__(self, ids):
         ids = np.asarray(ids)
         if ids.ndim == 1:  # parity mode: same batch replicated per host
+            if not getattr(self, "_warned_1d", False):
+                import warnings
+
+                warnings.warn(
+                    "DistFeature[1-D ids] broadcasts the batch to every "
+                    "host shard (n_hosts x bandwidth) — a parity shim for "
+                    "the reference's per-rank __getitem__.  Pass "
+                    "[n_hosts, B] ids to lookup() for the efficient path.",
+                    stacklevel=2,
+                )
+                self._warned_1d = True
             out = self.lookup(np.tile(ids[None], (self.n, 1)))
             return out[self.info.host]
         return self.lookup(ids)

@@ -116,6 +116,7 @@ class DistGraphSampler:
             slot = jnp.sum(jnp.where(onehot, rank_in, 0), axis=1)
             overflow = slot >= cap
             ok = valid & ~overflow
+            ocount = (valid & overflow).sum().astype(jnp.int32)
             dest = jnp.where(ok, owner * cap + slot, n * cap)
             reqs = jnp.zeros((n * cap,), jnp.int32).at[dest].add(
                 ids + 1, mode="drop"
@@ -139,7 +140,7 @@ class DistGraphSampler:
             got = jnp.take(flat, jnp.clip(dest, 0, n * cap - 1), axis=0)
             nbrs = jnp.where(ok[:, None], got - 1, -1)
             mask = nbrs >= 0
-            return nbrs[None], mask[None]
+            return nbrs[None], mask[None], ocount
 
         return body
 
@@ -154,14 +155,20 @@ class DistGraphSampler:
             key = jax.random.PRNGKey(seed_scalar)
             frontier, fmask = seeds[0], valid[0]
             blocks = []
+            ocounts = []
             for l, k in enumerate(sizes):
                 F = frontier.shape[0]
-                cap = max(int(np.ceil(F * frac / n)) * 2, 8)
-                cap = min(cap, F)
+                if frac >= 1.0:
+                    # truly exact: even if every frontier entry lands on one
+                    # shard, slot < F, so overflow is impossible
+                    cap = F
+                else:
+                    cap = min(max(int(np.ceil(F * frac / n)) * 2, 8), F)
                 key, sub = jax.random.split(key)
-                nbrs, mask = self._hop(k, cap)(
+                nbrs, mask, oc = self._hop(k, cap)(
                     ip, ix, frontier[None], fmask[None], sub
                 )
+                ocounts.append(oc)
                 nbrs, mask = nbrs[0], mask[0]
                 pos = (F + jnp.arange(F, dtype=jnp.int32)[:, None] * k
                        + jnp.arange(k, dtype=jnp.int32)[None, :])
@@ -185,7 +192,8 @@ class DistGraphSampler:
                 for b in blocks[::-1]  # outermost-first, like SampledBatch
             )
             return (frontier[None], fmask[None],
-                    fmask.sum().astype(jnp.int32)[None], blocks_out)
+                    fmask.sum().astype(jnp.int32)[None], blocks_out,
+                    jnp.stack(ocounts)[None])
 
         blocks_spec = tuple(
             LayerBlock(
@@ -200,7 +208,7 @@ class DistGraphSampler:
             in_specs=(P(self.axis, None), P(self.axis, None),
                       P(self.axis, None), P(self.axis, None), P()),
             out_specs=(P(self.axis, None), P(self.axis, None),
-                       P(self.axis), blocks_spec),
+                       P(self.axis), blocks_spec, P(self.axis, None)),
         )
         return jax.jit(f)
 
@@ -208,7 +216,13 @@ class DistGraphSampler:
         """``seed_batches``: [n_shards, B] — one seed batch per device;
         ``key``: int seed (PRNG keys are derived per shard inside).
         Returns per-shard :class:`SampledBatch`-style pytrees stacked on
-        the leading axis."""
+        the leading axis.
+
+        After each call ``self.last_overflow`` holds a ``[n_shards, L]``
+        device array of per-hop counts of frontier entries that overflowed
+        their destination bucket and were silently dropped (sampled 0
+        neighbors).  Always zero at ``request_cap_frac=1.0``.
+        """
         seeds = jnp.asarray(seed_batches, jnp.int32)
         nd, B = seeds.shape
         assert nd == self.n, (nd, self.n)
@@ -220,8 +234,19 @@ class DistGraphSampler:
         sh = NamedSharding(self.mesh, P(self.axis, None))
         seeds = jax.device_put(seeds, sh)
         valid = jax.device_put(valid, sh)
-        n_id, n_mask, num, blocks = self._fn[B](
+        n_id, n_mask, num, blocks, overflow = self._fn[B](
             self.indptr_sh, self.indices_sh, seeds, valid,
             jnp.int32(key),
         )
+        self.last_overflow = overflow
         return n_id, n_mask, num, blocks
+
+    def overflow_stats(self):
+        """Per-hop dropped-request counts from the most recent ``sample``
+        call, as a host ``[n_shards, L]`` int array (None before any call).
+        Parity note: the reference has no analogue — NCCL send/recv moves
+        exact ragged sizes; fixed-capacity buckets are the TPU trade, so
+        the drop counter is the safety net."""
+        if getattr(self, "last_overflow", None) is None:
+            return None
+        return np.asarray(self.last_overflow)

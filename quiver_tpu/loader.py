@@ -37,7 +37,9 @@ class SeedLoader:
     def __init__(self, train_idx, sampler, feature, labels=None,
                  batch_size: int = 1024, shuffle: bool = True,
                  drop_last: bool = False, prefetch: int = 2, seed: int = 0):
-        self.train_idx = np.asarray(train_idx)
+        # own copy: epoch shuffling is in-place and must not permute the
+        # caller's array (label alignment, cross-loader reproducibility)
+        self.train_idx = np.array(train_idx, copy=True)
         self.sampler = sampler
         self.feature = feature
         self.labels = None if labels is None else np.asarray(labels)

@@ -177,7 +177,8 @@ def sample_neighbors_weighted(
     pos = jnp.where(deg[:, None] <= k, start[:, None] + j, pos)
     nbrs = jnp.take(indices, jnp.where(mask, pos, 0), mode="clip")
     nbrs = jnp.where(mask, nbrs, jnp.int32(-1))
-    return SampleOut(nbrs=nbrs, mask=mask, counts=counts)
+    eid = jnp.where(mask, pos, jnp.int32(-1))
+    return SampleOut(nbrs=nbrs, mask=mask, counts=counts, eid=eid)
 
 
 def row_cumsum_weights(indptr, weights):
@@ -186,7 +187,11 @@ def row_cumsum_weights(indptr, weights):
     import numpy as np
 
     indptr = np.asarray(indptr)
-    w = np.asarray(weights, dtype=np.float32)
+    # Accumulate in float64: a global float32 cumsum over E~1e8 edges has
+    # ulp larger than typical per-edge weights, so late rows would get
+    # quantized/zeroed relative weights.  Per-row totals are small, so the
+    # final per-row float32 cast is safe.
+    w = np.asarray(weights, dtype=np.float64)
     cw = np.cumsum(w)
     # subtract the cumsum value just before each row start
     prev = np.concatenate([[0.0], cw])[indptr[:-1]]

@@ -56,7 +56,7 @@ def make_fused_train_step(sampler: GraphSageSampler, feature: Feature,
     @jax.jit
     def step(state: TrainState, seeds, labels, label_mask, key):
         ks, kd = jax.random.split(key)
-        n_id, n_mask, num, blocks = _sample_pipeline_nodedup(
+        n_id, n_mask, num, blocks, _ = _sample_pipeline_nodedup(
             indptr, indices, seeds, ks, sizes, gather_mode=gm
         )
         x = feature.lookup_device(n_id)
@@ -118,7 +118,7 @@ def make_fused_eval_fn(sampler: GraphSageSampler, feature: Feature,
 
     @jax.jit
     def eval_fn(params, seeds, key):
-        n_id, n_mask, num, blocks = _sample_pipeline_nodedup(
+        n_id, n_mask, num, blocks, _ = _sample_pipeline_nodedup(
             indptr, indices, seeds, key, sizes, gather_mode=gm
         )
         x = feature.lookup_device(n_id)

@@ -47,6 +47,7 @@ class LayerBlock(NamedTuple):
     nbr_local: jax.Array   # [T, k] int32 indices into this layer's n_id
     mask: jax.Array        # [T, k] bool
     num_targets: jax.Array  # scalar int32 (valid targets; T is the pad)
+    eid: Optional[jax.Array] = None  # [T, k] int32 global edge ids (-1 pad)
 
 
 class SampledBatch(NamedTuple):
@@ -60,7 +61,15 @@ class SampledBatch(NamedTuple):
         """Ragged ``(n_id, batch_size, [Adj])`` view, PyG-compatible.
 
         Host-side (numpy); mirrors ``sage_sampler.py:118-147``'s return.
-        Each Adj is ``(edge_index[2, e], e_id(empty), (n_src, n_dst))``.
+        Each Adj is ``(edge_index[2, e], e_id[e], (n_src, n_dst))``.
+
+        Sizes are the PADDED per-layer frontier lengths: each hop's target
+        frontier is by construction a *prefix* of its source frontier (both
+        pipelines append new nodes after the previous frontier), so the
+        standard PyG shrinking loop ``x = x[:size[1]]`` between layers
+        slices exactly the next layer's node set.  Masked pad slots hold
+        node 0 and are referenced by no edge, so they flow through as inert
+        rows; ``n_id`` is returned in full (padded) for the same reason.
         """
         adjs = []
         n_src = int(self.n_id.shape[0])
@@ -72,17 +81,16 @@ class SampledBatch(NamedTuple):
             col = nbr.astype(np.int64)
             e = m.reshape(-1)
             edge_index = np.stack([col.reshape(-1)[e], row.reshape(-1)[e]])
-            adjs.append(
-                (edge_index, np.empty(0), (n_src, int(blk.num_targets)))
-            )
-        # NOTE: local ids index the PADDED frontier (valid entries are not
-        # a contiguous prefix in dedup='none' mode), so n_id is returned
-        # in full; masked slots hold 0 and are referenced by no edge.
+            e_id = (np.asarray(blk.eid).reshape(-1)[e]
+                    if blk.eid is not None else np.empty(0, np.int64))
+            adjs.append((edge_index, e_id, (n_src, t)))
+            n_src = t  # this layer's targets = next (inner) layer's sources
         return (np.asarray(self.n_id), self.batch_size, adjs)
 
 
 def _sample_pipeline_nodedup(indptr, indices, seeds, key, sizes,
-                             gather_mode="xla", cum_weights=None):
+                             gather_mode="xla", cum_weights=None,
+                             return_eid=False):
     """Traced multi-hop pipeline WITHOUT dedup — the TPU hot path.
 
     Design note (why no hash table / no sort): the reference dedups every
@@ -119,6 +127,10 @@ def _sample_pipeline_nodedup(indptr, indices, seeds, key, sizes,
                 nbr_local=jnp.where(out.mask, pos, 0),
                 mask=out.mask,
                 num_targets=fmask.sum().astype(jnp.int32),
+                # None lets XLA DCE the eid computation entirely — an
+                # extra [T, k] int32 per hop is ~40% more sampler output
+                # HBM traffic, only worth it for edge-featured models
+                eid=out.eid if return_eid else None,
             )
         )
         frontier = jnp.concatenate(
@@ -126,41 +138,55 @@ def _sample_pipeline_nodedup(indptr, indices, seeds, key, sizes,
         )
         fmask = jnp.concatenate([fmask, out.mask.reshape(-1)])
     num_nodes = fmask.sum().astype(jnp.int32)
-    return frontier, fmask, num_nodes, tuple(blocks[::-1])
+    drops = jnp.zeros((len(sizes),), jnp.int32)  # nothing ever dropped
+    return frontier, fmask, num_nodes, tuple(blocks[::-1]), drops
 
 
 def _sample_pipeline(indptr, indices, seeds, key, sizes, caps,
-                     gather_mode="xla"):
+                     gather_mode="xla", cum_weights=None,
+                     return_eid=False):
     """Traced multi-hop pipeline: outward sampling with per-hop dedup."""
     B = seeds.shape[0]
     frontier = seeds.astype(jnp.int32)
     fmask = jnp.ones((B,), dtype=bool)
     blocks = []
+    drops = []  # per-hop count of frontier nodes dropped by the cap
     keys = jax.random.split(key, len(sizes))
     for l, (k, cap) in enumerate(zip(sizes, caps)):
-        out = sample_neighbors(indptr, indices, frontier, k, keys[l],
-                               seed_mask=fmask, gather_mode=gather_mode)
+        if cum_weights is not None:
+            out = sample_neighbors_weighted(indptr, indices, cum_weights,
+                                            frontier, k, keys[l],
+                                            seed_mask=fmask)
+        else:
+            out = sample_neighbors(indptr, indices, frontier, k, keys[l],
+                                   seed_mask=fmask, gather_mode=gather_mode)
         r = reindex(frontier, out.nbrs, out.mask, seed_mask=fmask)
         blocks.append(
             LayerBlock(
                 nbr_local=r.local_nbrs,
                 mask=r.mask,
                 num_targets=fmask.sum().astype(jnp.int32),
+                eid=out.eid if return_eid else None,
             )
         )
         n_id, n_mask = r.n_id, r.n_id_mask
+        drop = jnp.int32(0)
         if cap is not None and n_id.shape[0] > cap:
             # Keep the prefix: seeds region is intact (caps must be >= T);
             # dropped tail nodes get masked out of this layer's block.
+            drop = n_mask[cap:].sum().astype(jnp.int32)
             n_id, n_mask = n_id[:cap], n_mask[:cap]
             keep = blocks[-1].nbr_local < cap
             blocks[-1] = blocks[-1]._replace(
                 mask=blocks[-1].mask & keep,
                 nbr_local=jnp.where(keep, blocks[-1].nbr_local, 0),
+                eid=(jnp.where(keep, blocks[-1].eid, jnp.int32(-1))
+                     if blocks[-1].eid is not None else None),
             )
+        drops.append(drop)
         frontier, fmask = n_id, n_mask
     num_nodes = fmask.sum().astype(jnp.int32)
-    return frontier, fmask, num_nodes, tuple(blocks[::-1])
+    return frontier, fmask, num_nodes, tuple(blocks[::-1]), jnp.stack(drops)
 
 
 class GraphSageSampler:
@@ -179,13 +205,18 @@ class GraphSageSampler:
       edge_weights: optional ``[E]`` weights; hops then draw neighbors
         weight-proportionally WITH replacement
         (``ops.sample_neighbors_weighted``, reference weight_sample path).
+      return_eid: materialize per-edge global CSR positions in
+        ``LayerBlock.eid`` (and ``to_pyg_adjs`` e_id) for edge-featured
+        models.  Off by default: it costs an extra ``[T, k]`` int32 per
+        hop of output traffic, and the reference's default e_id is empty
+        too (``sage_sampler.py:143``).
     """
 
     def __init__(self, csr_topo: CSRTopo, sizes: Sequence[int], device=None,
                  mode: str = "TPU",
                  frontier_caps: Optional[Sequence[Optional[int]]] = None,
                  dedup: str = "none", gather_mode: str = "auto",
-                 edge_weights=None):
+                 edge_weights=None, return_eid: bool = False):
         assert mode in ("TPU", "CPU", "UVA", "GPU"), mode
         if mode in ("UVA", "GPU"):  # compat aliases from the reference API
             mode = "TPU"
@@ -205,6 +236,7 @@ class GraphSageSampler:
                     else "xla"
                 )
         self.gather_mode = gather_mode
+        self.return_eid = return_eid
         self.csr_topo = csr_topo
         self.sizes = list(sizes)
         self.mode = mode
@@ -215,13 +247,12 @@ class GraphSageSampler:
             else [None] * len(self.sizes)
         )
         assert len(self.frontier_caps) == len(self.sizes)
-        self._jitted = None
+        self._jitted = {}  # batch_size -> compiled pipeline (mixed-size
+        # workloads — e.g. serving buckets — must not evict each other)
         self._cpu = None
         self._cum_weights = None
         if edge_weights is not None:
-            assert mode == "TPU" and dedup == "none", (
-                "weighted sampling: TPU mode, dedup='none' only"
-            )
+            assert mode == "TPU", "weighted sampling: TPU mode only"
             cw = row_cumsum_weights(csr_topo.indptr, edge_weights)
             import jax.numpy as _jnp
 
@@ -269,14 +300,18 @@ class GraphSageSampler:
         gm = self.gather_mode
         cw = self._cum_weights
 
+        ret_eid = self.return_eid
+
         @jax.jit
         def fn(seeds, key):
             if dedup == "none":
                 return _sample_pipeline_nodedup(indptr, indices, seeds, key,
                                                 sizes, gather_mode=gm,
-                                                cum_weights=cw)
+                                                cum_weights=cw,
+                                                return_eid=ret_eid)
             return _sample_pipeline(indptr, indices, seeds, key, sizes, caps,
-                                    gather_mode=gm)
+                                    gather_mode=gm, cum_weights=cw,
+                                    return_eid=ret_eid)
 
         return fn
 
@@ -293,19 +328,31 @@ class GraphSageSampler:
         else:
             seeds = jnp.asarray(np.asarray(input_nodes), dtype=jnp.int32)
         B = seeds.shape[0]
-        if self._jitted is None or self._jitted[0] != B:
-            self._jitted = (B, self._build_jit(B))
+        fn = self._jitted.get(B)
+        if fn is None:
+            fn = self._jitted[B] = self._build_jit(B)
         key = key if key is not None else jax.random.PRNGKey(
             np.random.randint(0, 2**31 - 1)
         )
         from .utils.trace import trace_scope
 
         with trace_scope("sampler.sample"):
-            n_id, n_mask, num_nodes, blocks = self._jitted[1](seeds, key)
+            n_id, n_mask, num_nodes, blocks, drops = fn(seeds, key)
+        # [L] per-hop frontier-cap drop counts (always 0 without caps);
+        # kept on device until someone asks via overflow_stats()
+        self.last_drops = drops
         return SampledBatch(
             n_id=n_id, n_id_mask=n_mask, num_nodes=num_nodes,
             batch_size=B, layers=blocks,
         )
+
+    def overflow_stats(self):
+        """[L] per-hop counts of frontier nodes dropped by ``frontier_caps``
+        in the most recent ``sample`` call (None before any TPU-mode call;
+        always zero without caps or with ``dedup='none'``)."""
+        if getattr(self, "last_drops", None) is None:
+            return None
+        return np.asarray(self.last_drops)
 
     def _sample_cpu(self, input_nodes) -> SampledBatch:
         from .cpp import native

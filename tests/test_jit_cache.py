@@ -1,0 +1,47 @@
+"""Executable-cache behavior: alternating batch sizes must not recompile
+(VERDICT weak #3 — serving's bucketed shapes collide with a cache of 1)."""
+
+import numpy as np
+import jax
+import pytest
+
+from quiver_tpu import GraphSageSampler
+
+
+def test_sampler_cache_keeps_all_batch_sizes(small_graph):
+    s = GraphSageSampler(small_graph, [4, 3])
+    builds = []
+    orig = s._build_jit
+
+    def counting_build(B):
+        builds.append(B)
+        return orig(B)
+
+    s._build_jit = counting_build
+    for B in [8, 16, 8, 32, 16, 8, 32, 16]:
+        b = s.sample(np.arange(B, dtype=np.int64),
+                     key=jax.random.PRNGKey(B))
+        assert b.batch_size == B
+    # one build per distinct size, regardless of interleaving
+    assert sorted(builds) == [8, 16, 32]
+    assert sorted(s._jitted) == [8, 16, 32]
+
+
+def test_loader_does_not_mutate_caller_train_idx(small_graph):
+    from quiver_tpu.loader import SeedLoader
+
+    class _IdFeature:
+        def __getitem__(self, ids):
+            return np.zeros((len(ids), 2), np.float32)
+
+    s = GraphSageSampler(small_graph, [3])
+    train_idx = np.arange(40, dtype=np.int64)
+    snapshot = train_idx.copy()
+    loader = SeedLoader(train_idx, s, _IdFeature(), batch_size=16,
+                        shuffle=True, prefetch=0)
+    for _ in loader:
+        pass
+    # epoch shuffling must not leak into the caller's array
+    np.testing.assert_array_equal(train_idx, snapshot)
+    # but the loader itself did shuffle its own copy
+    assert not np.array_equal(loader.train_idx, snapshot)
