@@ -1,0 +1,110 @@
+"""Bounded TPU diagnosis: which compiles are slow over the axon tunnel.
+
+Each stage runs under a SIGALRM timeout and logs pass/fail + wall time, so
+one pathological compile cannot consume a whole tunnel-up window.  Run by
+``benchmarks/tpu_retry_loop.sh`` whenever the tunnel comes back.
+
+Key experiment: jit-compile latency of threefry vs rbg RNG — round 2's
+working hypothesis for the products-scale sampler compile hang.
+"""
+
+import os
+import signal
+import sys
+import time
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/root/repo/.jax_cache")
+sys.path.insert(0, "/root/repo")
+
+import numpy as np
+
+T0 = time.perf_counter()
+
+
+def log(m):
+    print(f"[{time.perf_counter() - T0:7.1f}s] {m}", flush=True)
+
+
+class Timeout(Exception):
+    pass
+
+
+def _alarm(sig, frm):
+    raise Timeout()
+
+
+signal.signal(signal.SIGALRM, _alarm)
+
+
+def stage(name, seconds, fn):
+    log(f"--- {name} (limit {seconds}s)")
+    signal.alarm(seconds)
+    t0 = time.perf_counter()
+    try:
+        out = fn()
+        dt = time.perf_counter() - t0
+        log(f"ok {name}: {dt:.2f}s" + (f" -> {out}" if out else ""))
+        return True
+    except Timeout:
+        log(f"TIMEOUT {name}")
+        return False
+    except Exception as e:
+        log(f"FAIL {name}: {type(e).__name__}: {e}")
+        return False
+    finally:
+        signal.alarm(0)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    stage("device init", 300, lambda: str(jax.devices()))
+    stage("trivial jit", 120,
+          lambda: float(jax.jit(lambda x: x * 2)(jnp.ones(8))[0]))
+
+    key_t = jax.random.key(0, impl="threefry2x32")
+    key_r = jax.random.key(0, impl="rbg")
+
+    stage("uniform rbg compile", 180,
+          lambda: jax.jit(
+              lambda k: jax.random.uniform(k, (1024, 15))
+          )(key_r).block_until_ready() and None)
+    stage("uniform threefry compile", 180,
+          lambda: jax.jit(
+              lambda k: jax.random.uniform(k, (1024, 15))
+          )(key_t).block_until_ready() and None)
+
+    from quiver_tpu import CSRTopo, GraphSageSampler
+    from quiver_tpu.utils.synthetic import synthetic_csr
+
+    indptr, indices = synthetic_csr(100_000, 2_000_000, 0)
+    topo = CSRTopo(indptr=indptr, indices=indices)
+
+    def hop(gm, key):
+        s = GraphSageSampler(topo, [15], gather_mode=gm)
+        seeds = np.arange(256, dtype=np.int32)
+        s.sample(seeds, key=key).n_id.block_until_ready()
+
+    stage("one-hop xla + rbg", 240, lambda: hop("xla", key_r))
+    stage("one-hop xla + threefry", 240, lambda: hop("xla", key_t))
+    stage("one-hop pallas + rbg", 240, lambda: hop("pallas", key_r))
+    stage("one-hop lanes + rbg", 240, lambda: hop("lanes", key_r))
+
+    def hop3(gm):
+        s = GraphSageSampler(topo, [15, 10, 5], gather_mode=gm)
+        seeds = np.arange(1024, dtype=np.int32)
+        s.sample(seeds, key=key_r).n_id.block_until_ready()
+        t0 = time.perf_counter()
+        for i in range(3):
+            s.sample(seeds, key=jax.random.fold_in(key_r, i)
+                     ).n_id.block_until_ready()
+        return f"{(time.perf_counter() - t0) / 3 * 1e3:.1f} ms/batch steady"
+
+    stage("3-hop xla + rbg (small graph)", 300, lambda: hop3("xla"))
+    stage("3-hop pallas + rbg (small graph)", 300, lambda: hop3("pallas"))
+    log("DIAGNOSE DONE")
+
+
+if __name__ == "__main__":
+    main()

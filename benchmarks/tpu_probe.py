@@ -1,0 +1,120 @@
+"""Staged TPU probe: find which compile/execute step is slow over the
+axon tunnel.  Each stage logs start/stop with wall time; run under nohup
+and tail the log."""
+
+import os
+import sys
+import time
+
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 ".jax_cache"),
+)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+T0 = time.perf_counter()
+
+
+def log(msg):
+    print(f"[{time.perf_counter() - T0:8.1f}s] {msg}", flush=True)
+
+
+def stage(name):
+    log(f"--- {name}")
+
+
+stage("import jax + device init")
+import jax
+import jax.numpy as jnp
+
+log(f"devices: {jax.devices()}")
+
+stage("trivial jit")
+x = jnp.arange(8.0)
+y = jax.jit(lambda a: a * 2 + 1)(x)
+y.block_until_ready()
+log(f"trivial ok: {np.asarray(y)[:3]}")
+
+stage("big array upload (490MB)")
+arr = np.arange(123_000_000, dtype=np.int32)
+d = jax.device_put(arr)
+d.block_until_ready()
+log("upload ok")
+
+stage("simple take gather (1M from 123M)")
+ids = jnp.asarray(np.random.default_rng(0).integers(0, 123_000_000, 1_000_000,
+                                                    dtype=np.int32))
+g = jax.jit(lambda a, i: jnp.take(a, i))
+r = g(d, ids)
+r.block_until_ready()
+log("take compile+run ok")
+t = time.perf_counter()
+for _ in range(5):
+    r = g(d, ids)
+r.block_until_ready()
+log(f"take steady: {(time.perf_counter() - t) / 5 * 1e3:.1f} ms")
+
+from quiver_tpu import CSRTopo, GraphSageSampler
+from quiver_tpu.utils.synthetic import synthetic_csr
+
+stage("small graph (100K/2M) one-hop xla")
+indptr, indices = synthetic_csr(100_000, 2_000_000, 0)
+topo_s = CSRTopo(indptr=indptr, indices=indices)
+s = GraphSageSampler(topo_s, [15], gather_mode="xla")
+seeds = np.random.default_rng(1).integers(0, 100_000, 256).astype(np.int32)
+b = s.sample(seeds)
+b.n_id.block_until_ready()
+log("small one-hop xla ok")
+
+stage("small graph 3-hop xla [15,10,5] B=256")
+s3 = GraphSageSampler(topo_s, [15, 10, 5], gather_mode="xla")
+b = s3.sample(seeds)
+b.n_id.block_until_ready()
+log("small 3-hop xla ok")
+
+stage("small graph 3-hop lanes B=256")
+s3l = GraphSageSampler(topo_s, [15, 10, 5], gather_mode="lanes")
+b = s3l.sample(seeds)
+b.n_id.block_until_ready()
+log("small 3-hop lanes ok")
+
+stage("products graph gen+upload")
+indptr, indices = synthetic_csr(2_449_029, 123_718_280, 0)
+topo = CSRTopo(indptr=indptr, indices=indices)
+topo.to_device()
+log("products upload ok")
+
+stage("products one-hop xla B=256")
+s1 = GraphSageSampler(topo, [15], gather_mode="xla")
+b = s1.sample(seeds % 2_449_029)
+b.n_id.block_until_ready()
+log("products one-hop xla ok")
+
+stage("products 3-hop xla B=256")
+sp = GraphSageSampler(topo, [15, 10, 5], gather_mode="xla")
+b = sp.sample(seeds % 2_449_029)
+b.n_id.block_until_ready()
+log("products 3-hop xla ok")
+t = time.perf_counter()
+for i in range(5):
+    b = sp.sample(seeds % 2_449_029, key=jax.random.PRNGKey(i))
+b.n_id.block_until_ready()
+log(f"products 3-hop xla steady: {(time.perf_counter() - t) / 5 * 1e3:.1f} "
+    f"ms/batch")
+
+stage("products 3-hop lanes B=256")
+spl = GraphSageSampler(topo, [15, 10, 5], gather_mode="lanes")
+b = spl.sample(seeds % 2_449_029)
+b.n_id.block_until_ready()
+log("products 3-hop lanes ok")
+t = time.perf_counter()
+for i in range(5):
+    b = spl.sample(seeds % 2_449_029, key=jax.random.PRNGKey(i))
+b.n_id.block_until_ready()
+log(f"products 3-hop lanes steady: {(time.perf_counter() - t) / 5 * 1e3:.1f} "
+    f"ms/batch")
+
+log("ALL STAGES DONE")

@@ -96,6 +96,69 @@ void qt_sample(const int64_t* indptr, const int32_t* indices,
     for (auto& t : ts) t.join();
 }
 
+// Weighted one-hop sampling WITH replacement (parity: the reference's
+// weight_sample thrust path, cuda_random.cu.hpp:149-221).  cumw is the
+// per-row inclusive cumulative weight array produced by
+// quiver_tpu.ops.sample.row_cumsum_weights — the same artifact the TPU
+// weighted sampler uses, so CPU/TPU draws share one distribution.
+void qt_sample_weighted(const int64_t* indptr, const int32_t* indices,
+                        const float* cumw, const int32_t* seeds,
+                        const uint8_t* seed_mask, int64_t B, int32_t k,
+                        uint64_t rng_seed, int32_t n_threads,
+                        int32_t* out_nbrs, uint8_t* out_mask,
+                        int32_t* out_counts) {
+    if (n_threads <= 0) {
+        n_threads = (int32_t)std::thread::hardware_concurrency();
+        if (n_threads <= 0) n_threads = 1;
+    }
+    auto work = [&](int64_t lo, int64_t hi) {
+        for (int64_t b = lo; b < hi; ++b) {
+            int32_t* nb = out_nbrs + b * k;
+            uint8_t* mk = out_mask + b * k;
+            if (seed_mask && !seed_mask[b]) {
+                out_counts[b] = 0;
+                std::memset(mk, 0, k);
+                std::fill(nb, nb + k, -1);
+                continue;
+            }
+            const int64_t s = seeds[b];
+            const int64_t beg = indptr[s], end = indptr[s + 1];
+            const int64_t deg = end - beg;
+            const int64_t cnt = deg < k ? deg : k;
+            out_counts[b] = (int32_t)cnt;
+            Rng rng(rng_seed * 0x2545F4914F6CDD1DULL + (uint64_t)b);
+            if (deg <= k) {  // all neighbors once (mask contract parity)
+                for (int64_t j = 0; j < cnt; ++j) nb[j] = indices[beg + j];
+            } else {
+                const float total = cumw[end - 1];
+                for (int64_t j = 0; j < k; ++j) {
+                    // 53-bit uniform in [0, total)
+                    double u = (double)(rng.next() >> 11) * 0x1p-53 * total;
+                    const float* p = std::upper_bound(
+                        cumw + beg, cumw + end, (float)u);
+                    int64_t pos = p - (cumw + beg);
+                    if (pos >= deg) pos = deg - 1;
+                    nb[j] = indices[beg + pos];
+                }
+            }
+            for (int64_t j = 0; j < k; ++j) mk[j] = j < cnt;
+            for (int64_t j = cnt; j < k; ++j) nb[j] = -1;
+        }
+    };
+    if (n_threads == 1 || B < 256) {
+        work(0, B);
+        return;
+    }
+    std::vector<std::thread> ts;
+    int64_t chunk = (B + n_threads - 1) / n_threads;
+    for (int32_t t = 0; t < n_threads; ++t) {
+        int64_t lo = t * chunk, hi = std::min(B, lo + chunk);
+        if (lo >= hi) break;
+        ts.emplace_back(work, lo, hi);
+    }
+    for (auto& t : ts) t.join();
+}
+
 // Dedup + relabel, same contract as quiver_tpu.ops.reindex: n_id holds the
 // (valid) seeds in their original slots, then the unique non-seed neighbors
 // in ascending id order.  Returns the number of valid frontier nodes.

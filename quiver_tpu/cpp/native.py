@@ -57,6 +57,13 @@ def _get_lib() -> Optional[ctypes.CDLL]:
                 i32p, u8p, i32p,
             ]
             lib.qt_sample.restype = None
+            f32p = np.ctypeslib.ndpointer(np.float32, flags="C")
+            lib.qt_sample_weighted.argtypes = [
+                i64p, i32p, f32p, i32p, ctypes.c_void_p, ctypes.c_int64,
+                ctypes.c_int32, ctypes.c_uint64, ctypes.c_int32,
+                i32p, u8p, i32p,
+            ]
+            lib.qt_sample_weighted.restype = None
             lib.qt_reindex.argtypes = [
                 i32p, ctypes.c_void_p, ctypes.c_int64, i32p, u8p,
                 ctypes.c_int32, i32p, u8p, i32p,
@@ -90,12 +97,21 @@ class CPUSampler:
     """Host-side sampler with the same dense-block contract as the TPU ops."""
 
     def __init__(self, indptr: np.ndarray, indices: np.ndarray,
-                 n_threads: int = 0, seed: int = 0x5EED):
+                 n_threads: int = 0, seed: int = 0x5EED,
+                 edge_weights: Optional[np.ndarray] = None):
         self.indptr = np.ascontiguousarray(indptr, dtype=np.int64)
         self.indices = np.ascontiguousarray(indices, dtype=np.int32)
         self.n_threads = n_threads
         self._seed = seed
         self._ctr = 0
+        self.cum_weights = None
+        if edge_weights is not None:
+            from ..ops.sample import row_cumsum_weights
+
+            self.cum_weights = np.ascontiguousarray(
+                row_cumsum_weights(self.indptr, edge_weights),
+                dtype=np.float32,
+            )
 
     def _next_seed(self) -> int:
         self._ctr += 1
@@ -113,7 +129,32 @@ class CPUSampler:
             else np.ascontiguousarray(seed_mask, dtype=np.uint8)
         )
         lib = _get_lib()
-        if lib is not None:
+        if lib is not None and self.cum_weights is not None:
+            lib.qt_sample_weighted(
+                self.indptr, self.indices, self.cum_weights, seeds,
+                _as_u8_ptr(sm), B, k, self._next_seed(), self.n_threads,
+                nbrs.reshape(-1), mask.reshape(-1), counts)
+        elif self.cum_weights is not None:  # numpy weighted fallback
+            rng = np.random.default_rng(self._next_seed() % 2**32)
+            cw = self.cum_weights
+            for b in range(B):
+                if sm is not None and not sm[b]:
+                    counts[b], mask[b], nbrs[b] = 0, 0, -1
+                    continue
+                beg, end = self.indptr[seeds[b]], self.indptr[seeds[b] + 1]
+                deg = end - beg
+                c = int(min(deg, k))
+                counts[b] = c
+                if deg <= k:
+                    nbrs[b, :c] = self.indices[beg:end]
+                else:
+                    u = rng.random(k).astype(np.float64) * cw[end - 1]
+                    pos = np.searchsorted(cw[beg:end], u, side="right")
+                    nbrs[b, :k] = self.indices[beg + np.minimum(pos, deg - 1)]
+                nbrs[b, c:] = -1
+                mask[b] = np.arange(k) < c
+            return nbrs, mask.astype(bool), counts
+        elif lib is not None:
             lib.qt_sample(self.indptr, self.indices, seeds, _as_u8_ptr(sm),
                           B, k, self._next_seed(), self.n_threads,
                           nbrs.reshape(-1), mask.reshape(-1), counts)

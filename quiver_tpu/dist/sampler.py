@@ -145,14 +145,17 @@ class DistGraphSampler:
         return body
 
     def _build(self, B: int):
+        from ..utils.rng import default_impl
+
         sizes = tuple(self.sizes)
         n, axis = self.n, self.axis
         frac = self.request_cap_frac
+        prng_impl = default_impl()  # honors QUIVER_TPU_PRNG override
 
         def pipeline(ip, ix, seeds, valid, seed_scalar):
             # seeds/valid: [1, B] per-shard (every shard runs the same
             # program on ITS OWN seed batch — data-parallel sampling)
-            key = jax.random.PRNGKey(seed_scalar)
+            key = jax.random.key(seed_scalar, impl=prng_impl)
             frontier, fmask = seeds[0], valid[0]
             blocks = []
             ocounts = []

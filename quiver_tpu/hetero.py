@@ -212,9 +212,10 @@ class HeteroGraphSageSampler:
             self._jitted[B] = jax.jit(
                 lambda s, k: self._pipeline(s, k)
             )
-        key = key if key is not None else jax.random.PRNGKey(
-            np.random.randint(0, 2**31 - 1)
-        )
+        if key is None:
+            from .utils.rng import make_key
+
+            key = make_key(np.random.randint(0, 2**31 - 1))
         n_id, n_mask, layers = self._jitted[B](seeds, key)
         return HeteroSampledBatch(
             n_id=n_id, n_id_mask=n_mask, batch_size=B,

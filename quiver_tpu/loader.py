@@ -49,6 +49,7 @@ class SeedLoader:
         self.prefetch = prefetch
         self._rng = np.random.default_rng(seed)
         self._epoch = 0
+        self._lookahead = {}
 
     def __len__(self):
         n = len(self.train_idx)
@@ -56,9 +57,8 @@ class SeedLoader:
             (n + self.batch_size - 1) // self.batch_size
         )
 
-    def _make(self, i: int):
+    def _sample(self, i: int):
         import jax
-        import jax.numpy as jnp
 
         B = self.batch_size
         seeds = self.train_idx[i * B: (i + 1) * B]
@@ -67,10 +67,30 @@ class SeedLoader:
             seeds = np.concatenate(
                 [seeds, np.repeat(seeds[:1] if valid else [0], B - valid)]
             )
-        key = jax.random.PRNGKey(
-            (self._epoch * 1_000_003 + i) & 0x7FFFFFFF
-        )
-        batch = self.sampler.sample(seeds, key=key)
+        from .utils.rng import make_key
+
+        key = make_key((self._epoch * 1_000_003 + i) & 0x7FFFFFFF)
+        return seeds, valid, self.sampler.sample(seeds, key=key)
+
+    def _make(self, i: int):
+        import jax.numpy as jnp
+
+        B = self.batch_size
+        e = self._epoch  # keyed by epoch: a straggler worker from an
+        # abandoned epoch can't feed its stale batch to the next one
+        got = self._lookahead.pop((e, i), None)
+        seeds, valid, batch = got if got is not None else self._sample(i)
+        if i + 1 < len(self):
+            # dispatch the next batch's sample now and start its cold-tier
+            # feature prefetch — the host gather for batch i+1 runs while
+            # batch i is on the device (Feature.prefetch double-buffering).
+            # n_id stays a device array here: Feature.prefetch materializes
+            # it on ITS worker thread, so this thread never blocks on the
+            # i+1 sample.
+            nxt = self._sample(i + 1)
+            self._lookahead[(e, i + 1)] = nxt
+            if hasattr(self.feature, "prefetch"):
+                self.feature.prefetch(nxt[2].n_id)
         x = self.feature[np.asarray(batch.n_id)]
         mask = jnp.arange(B) < valid
         if self.labels is not None:
@@ -83,6 +103,7 @@ class SeedLoader:
         if self.shuffle:
             self._rng.shuffle(self.train_idx)
         self._epoch += 1
+        self._lookahead = {}
         n = len(self)
         if self.prefetch > 0:
             return iter(Prefetcher(range(n), self._make,

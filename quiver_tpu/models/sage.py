@@ -43,52 +43,13 @@ class GraphSAGE(nn.Module):
 
 def full_graph_inference(params, x, indptr, indices, num_layers: int,
                          edge_chunk: int = 4_000_000):
-    """Exact layer-wise full-graph inference with trained GraphSAGE params.
+    """Exact layer-wise full-graph SAGE inference (legacy entry point).
 
-    The reference examples evaluate accuracy with PyG's layer-wise
-    ``inference()`` over ALL neighbors (no sampling), e.g.
-    ``examples/pyg/ogbn_products_sage_quiver.py``'s test pass.  Here the
-    exact mean aggregation is a chunked ``segment_sum`` over the CSR edge
-    array — one pass per layer, bandwidth-bound, no sampling noise.
-
-    Args:
-      params: the flax params of :class:`GraphSAGE` (``conv{i}`` keys).
-      x: ``[N, D]`` full feature matrix (device).
-      indptr/indices: host or device CSR (edge-chunk streamed).
-    Returns ``[N, out_dim]`` logits.
+    Delegates to :func:`quiver_tpu.models.inference.full_graph_inference`,
+    which also handles GCN/GAT layouts; kept so round-1 call sites
+    (``full_graph_inference(params, x, ip, ix, L)``) keep working.
     """
-    import numpy as np
+    from .inference import full_graph_inference as _gi
 
-    p = params["params"] if "params" in params else params
-    n = x.shape[0]
-    indptr_np = np.asarray(indptr[: n + 1])
-    indices_dev = jnp.asarray(np.asarray(indices)[: int(indptr_np[-1])])
-    deg = jnp.asarray(
-        (indptr_np[1:] - indptr_np[:-1]).astype(np.float32)
-    )
-    # per-edge target row (host once; streamed in chunks below)
-    row_of_edge = np.repeat(
-        np.arange(n, dtype=np.int64), indptr_np[1:] - indptr_np[:-1]
-    )
-
-    @jax.jit
-    def agg_chunk(acc, h, rows, cols):
-        return acc.at[rows].add(jnp.take(h, cols, axis=0))
-
-    for i in range(num_layers):
-        conv = p[f"conv{i}"]
-        w_self = jnp.asarray(conv["lin_self"]["kernel"])
-        b_self = jnp.asarray(conv["lin_self"]["bias"])
-        w_nbr = jnp.asarray(conv["lin_nbr"]["kernel"])
-        acc = jnp.zeros((n, x.shape[1]), x.dtype)
-        e_total = len(row_of_edge)
-        for lo in range(0, e_total, edge_chunk):
-            hi = min(lo + edge_chunk, e_total)
-            rows = jnp.asarray(row_of_edge[lo:hi])
-            cols = indices_dev[lo:hi]
-            acc = agg_chunk(acc, x, rows, cols)
-        mean_nbr = acc / jnp.maximum(deg, 1.0)[:, None]
-        x = x @ w_self + b_self + mean_nbr @ w_nbr
-        if i != num_layers - 1:
-            x = jax.nn.relu(x)
-    return x
+    return _gi(params, x, indptr, indices, num_layers,
+               edge_chunk=edge_chunk)

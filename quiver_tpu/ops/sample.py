@@ -57,6 +57,13 @@ def _gather(table: jax.Array, idx: jax.Array, mode: str) -> jax.Array:
             jnp.clip(idx, 0, m - 1),
             fused=(mode == "lanes_fused"),
         )
+    if mode == "pallas":
+        from .pallas.sample_gather_kernel import pallas_element_gather
+
+        m = table.shape[0] // 128 * 128
+        return pallas_element_gather(
+            table[:m].reshape(-1, 128), jnp.clip(idx, 0, m - 1)
+        )
     return jnp.take(table, idx, mode="clip")
 
 

@@ -20,7 +20,7 @@ import jax.numpy as jnp
 import optax
 
 from .feature import Feature
-from .sampler import GraphSageSampler, _sample_pipeline_nodedup
+from .sampler import GraphSageSampler, run_pipeline
 from .parallel.train import TrainState
 
 __all__ = ["make_fused_train_step", "make_fused_eval_fn"]
@@ -44,6 +44,8 @@ def make_fused_train_step(sampler: GraphSageSampler, feature: Feature,
     indptr, indices = sampler.csr_topo.to_device(sampler.device)
     sizes = tuple(sampler.sizes)
     gm = sampler.gather_mode
+    dedup = sampler.dedup
+    caps = tuple(sampler.frontier_caps)
 
     if loss_fn is None:
         def loss_fn(logits, labels, mask):
@@ -56,8 +58,8 @@ def make_fused_train_step(sampler: GraphSageSampler, feature: Feature,
     @jax.jit
     def step(state: TrainState, seeds, labels, label_mask, key):
         ks, kd = jax.random.split(key)
-        n_id, n_mask, num, blocks, _ = _sample_pipeline_nodedup(
-            indptr, indices, seeds, ks, sizes, gather_mode=gm
+        n_id, n_mask, num, blocks, _ = run_pipeline(
+            dedup, indptr, indices, seeds, ks, sizes, caps, gather_mode=gm
         )
         x = feature.lookup_device(n_id)
 
@@ -116,10 +118,13 @@ def make_fused_eval_fn(sampler: GraphSageSampler, feature: Feature,
     sizes = tuple(sampler.sizes)
     gm = sampler.gather_mode
 
+    dedup = sampler.dedup
+    caps = tuple(sampler.frontier_caps)
+
     @jax.jit
     def eval_fn(params, seeds, key):
-        n_id, n_mask, num, blocks, _ = _sample_pipeline_nodedup(
-            indptr, indices, seeds, key, sizes, gather_mode=gm
+        n_id, n_mask, num, blocks, _ = run_pipeline(
+            dedup, indptr, indices, seeds, key, sizes, caps, gather_mode=gm
         )
         x = feature.lookup_device(n_id)
         return apply_fn(params, x, blocks, train=False, rngs=None)

@@ -56,6 +56,9 @@ class SampledBatch(NamedTuple):
     num_nodes: jax.Array    # scalar int32
     batch_size: int         # static: number of seed nodes
     layers: Tuple[LayerBlock, ...]  # outermost-first (PyG adjs order)
+    drops: Optional[jax.Array] = None  # [L] per-hop frontier-cap drop
+    # counts for THIS batch (overflow_stats(batch) reads it; the
+    # sampler-level last_drops is unreliable under prefetching)
 
     def to_pyg_adjs(self):
         """Ragged ``(n_id, batch_size, [Adj])`` view, PyG-compatible.
@@ -189,6 +192,20 @@ def _sample_pipeline(indptr, indices, seeds, key, sizes, caps,
     return frontier, fmask, num_nodes, tuple(blocks[::-1]), jnp.stack(drops)
 
 
+def run_pipeline(dedup, indptr, indices, seeds, key, sizes, caps,
+                 gather_mode="xla", cum_weights=None, return_eid=False):
+    """Dispatch to the dedup='none' or dedup='hop' traced pipeline — the
+    single place that mapping lives (sampler jit + fused train/eval)."""
+    if dedup == "none":
+        return _sample_pipeline_nodedup(indptr, indices, seeds, key, sizes,
+                                        gather_mode=gather_mode,
+                                        cum_weights=cum_weights,
+                                        return_eid=return_eid)
+    return _sample_pipeline(indptr, indices, seeds, key, sizes, caps,
+                            gather_mode=gather_mode,
+                            cum_weights=cum_weights, return_eid=return_eid)
+
+
 class GraphSageSampler:
     """K-hop neighbor sampler over a CSR graph.
 
@@ -221,7 +238,8 @@ class GraphSageSampler:
         if mode in ("UVA", "GPU"):  # compat aliases from the reference API
             mode = "TPU"
         assert dedup in ("none", "hop"), dedup
-        assert gather_mode in ("auto", "xla", "lanes", "lanes_fused"), gather_mode
+        assert gather_mode in ("auto", "xla", "lanes", "lanes_fused",
+                               "pallas"), gather_mode
         if gather_mode == "auto":
             from .config import get_config
 
@@ -251,8 +269,8 @@ class GraphSageSampler:
         # workloads — e.g. serving buckets — must not evict each other)
         self._cpu = None
         self._cum_weights = None
-        if edge_weights is not None:
-            assert mode == "TPU", "weighted sampling: TPU mode only"
+        self._edge_weights = edge_weights
+        if edge_weights is not None and mode == "TPU":
             cw = row_cumsum_weights(csr_topo.indptr, edge_weights)
             import jax.numpy as _jnp
 
@@ -264,7 +282,10 @@ class GraphSageSampler:
     #    sage_sampler.py:83-116) --------------------------------------
     def sample_layer(self, batch, size: int, key=None):
         indptr, indices = self.csr_topo.to_device(self.device)
-        key = key if key is not None else jax.random.PRNGKey(0)
+        if key is None:
+            from .utils.rng import make_key
+
+            key = make_key(0)
         seeds = jnp.asarray(np.asarray(batch), dtype=jnp.int32)
         return sample_neighbors(indptr, indices, seeds, size, key)
 
@@ -304,14 +325,9 @@ class GraphSageSampler:
 
         @jax.jit
         def fn(seeds, key):
-            if dedup == "none":
-                return _sample_pipeline_nodedup(indptr, indices, seeds, key,
-                                                sizes, gather_mode=gm,
-                                                cum_weights=cw,
-                                                return_eid=ret_eid)
-            return _sample_pipeline(indptr, indices, seeds, key, sizes, caps,
-                                    gather_mode=gm, cum_weights=cw,
-                                    return_eid=ret_eid)
+            return run_pipeline(dedup, indptr, indices, seeds, key, sizes,
+                                caps, gather_mode=gm, cum_weights=cw,
+                                return_eid=ret_eid)
 
         return fn
 
@@ -331,9 +347,10 @@ class GraphSageSampler:
         fn = self._jitted.get(B)
         if fn is None:
             fn = self._jitted[B] = self._build_jit(B)
-        key = key if key is not None else jax.random.PRNGKey(
-            np.random.randint(0, 2**31 - 1)
-        )
+        if key is None:
+            from .utils.rng import make_key
+
+            key = make_key(np.random.randint(0, 2**31 - 1))
         from .utils.trace import trace_scope
 
         with trace_scope("sampler.sample"):
@@ -343,13 +360,21 @@ class GraphSageSampler:
         self.last_drops = drops
         return SampledBatch(
             n_id=n_id, n_id_mask=n_mask, num_nodes=num_nodes,
-            batch_size=B, layers=blocks,
+            batch_size=B, layers=blocks, drops=drops,
         )
 
-    def overflow_stats(self):
-        """[L] per-hop counts of frontier nodes dropped by ``frontier_caps``
-        in the most recent ``sample`` call (None before any TPU-mode call;
-        always zero without caps or with ``dedup='none'``)."""
+    def overflow_stats(self, batch: Optional[SampledBatch] = None):
+        """[L] per-hop counts of frontier nodes dropped by ``frontier_caps``.
+
+        Pass the :class:`SampledBatch` to get THAT batch's counts — the
+        only reliable form when a loader samples ahead (``SeedLoader``
+        dispatches batch i+1 before batch i is consumed, so the
+        sampler-level "most recent call" is usually the next batch).
+        Without ``batch``: the most recent ``sample`` call (None before
+        any TPU-mode call; always zero without caps or ``dedup='none'``).
+        """
+        if batch is not None:
+            return None if batch.drops is None else np.asarray(batch.drops)
         if getattr(self, "last_drops", None) is None:
             return None
         return np.asarray(self.last_drops)
@@ -359,7 +384,8 @@ class GraphSageSampler:
 
         if self._cpu is None:
             self._cpu = native.CPUSampler(
-                self.csr_topo.indptr, self.csr_topo.indices
+                self.csr_topo.indptr, self.csr_topo.indices,
+                edge_weights=self._edge_weights,
             )
         seeds = np.asarray(input_nodes, dtype=np.int64)
         n_id, n_mask, num_nodes, blocks = self._cpu.sample_multihop(

@@ -194,18 +194,46 @@ class InferenceServer:
     # -- core per-request paths ---------------------------------------
     def _pad_ids(self, ids: np.ndarray) -> np.ndarray:
         b = _next_bucket(len(ids), self.BUCKETS)
-        if len(ids) >= b:  # at or above the top bucket: run as-is
+        if len(ids) >= b:  # at the top bucket exactly (chunking caps len)
             return ids
         return np.concatenate([ids, np.full(b - len(ids), ids[0] if len(ids)
                                             else 0, dtype=ids.dtype)])
 
+    def _run_bucketed(self, ids: np.ndarray) -> np.ndarray:
+        """One padded device pass per <=top-bucket chunk.
+
+        Requests above the top bucket are CHUNKED into top-bucket pieces so
+        every device program is one of the |BUCKETS| pre-compiled shapes —
+        an unbounded request size never triggers a fresh compile (the
+        reference has no analogue: CUDA kernels take any shape; XLA
+        executables don't).
+        """
+        top = self.BUCKETS[-1]
+        outs = []
+        for off in range(0, max(len(ids), 1), top):  # empty ids: one
+            # zero-length chunk, padded to the smallest bucket
+            chunk = ids[off: off + top]
+            batch = self.sampler.sample(self._pad_ids(chunk))
+            x = self.feature[np.asarray(batch.n_id)]
+            out = self.apply_fn(self.params, x, batch.layers)
+            outs.append(np.asarray(out)[: len(chunk)])
+        return outs[0] if len(outs) == 1 else np.concatenate(outs)
+
+    def warmup(self, example_node: int = 0):
+        """Compile every bucket's executable before traffic arrives.
+
+        The reference pays no warmup (CUDA shape-polymorphism); on TPU a
+        cold bucket would stall its first request for the ~seconds-long
+        compile, wrecking p99 — so serve only after this returns.
+        """
+        for b in self.BUCKETS:
+            ids = np.full(b, example_node, dtype=np.int64)
+            self._run_bucketed(ids)
+        return self
+
     def _infer_device(self, req: ServingRequest):
         ids = np.asarray(req.ids)
-        padded = self._pad_ids(ids)
-        batch = self.sampler.sample(padded)
-        x = self.feature[np.asarray(batch.n_id)]
-        out = self.apply_fn(self.params, x, batch.layers)
-        return np.asarray(out)[: len(ids)]
+        return self._run_bucketed(ids)[: len(ids)]
 
     def _infer_presampled(self, req: ServingRequest, batch):
         x = self.feature[np.asarray(batch.n_id)]
@@ -245,10 +273,7 @@ class InferenceServer:
 
     def _infer_coalesced(self, reqs):
         ids = np.concatenate([np.asarray(r.ids) for r in reqs])
-        padded = self._pad_ids(ids)
-        batch = self.sampler.sample(padded)
-        x = self.feature[np.asarray(batch.n_id)]
-        out = np.asarray(self.apply_fn(self.params, x, batch.layers))
+        out = self._run_bucketed(ids)
         off = 0
         outs = []
         for r in reqs:

@@ -4,19 +4,37 @@ The reference delegates checkpointing to user code (``torch.save`` of the
 model; partition artifacts as ``.pt`` files — SURVEY.md §5).  We provide a
 library-level equivalent so training scripts stay 3-line swaps: save/restore
 of the :class:`quiver_tpu.parallel.TrainState` (params + optimizer state)
-plus arbitrary numpy metadata, using orbax when available and a plain
-npz/pickle fallback otherwise.
+plus arbitrary numpy metadata.
+
+Two backends:
+  * **orbax** (default when importable — it is in the standard image):
+    ``{path}/ckpt_{step}/`` in orbax's tensorstore format.  Handles sharded
+    ``jax.Array`` params natively, which matters for the papers100M-scale
+    multi-host configs where a pickled host copy would not even fit.
+  * **pickle** fallback: ``{path}/ckpt_{step}.pkl`` host-numpy pytree.
+
+Both publish atomically (write to a temp name, then rename).
 """
 
 from __future__ import annotations
 
 import os
 import pickle
+import shutil
 from typing import Any, Dict, Optional
 
 import numpy as np
 
 __all__ = ["save_checkpoint", "load_checkpoint", "latest_checkpoint"]
+
+
+def _orbax():
+    try:
+        import orbax.checkpoint as ocp
+
+        return ocp
+    except Exception:  # pragma: no cover — orbax is in the image
+        return None
 
 
 def _to_host(tree):
@@ -26,9 +44,37 @@ def _to_host(tree):
 
 
 def save_checkpoint(path: str, state, step: int,
-                    extra: Optional[Dict[str, Any]] = None) -> str:
-    """Write ``{path}/ckpt_{step}.pkl`` (host numpy pytree)."""
+                    extra: Optional[Dict[str, Any]] = None,
+                    backend: str = "auto") -> str:
+    """Write step ``step``; returns the checkpoint path.
+
+    ``backend``: "auto" (orbax if available), "orbax", or "pickle".
+    """
+    assert backend in ("auto", "orbax", "pickle"), backend
     os.makedirs(path, exist_ok=True)
+    ocp = _orbax() if backend in ("auto", "orbax") else None
+    if backend == "orbax" and ocp is None:
+        raise RuntimeError("orbax requested but not importable")
+    if ocp is not None:
+        f = os.path.join(os.path.abspath(path), f"ckpt_{step}")
+        tmp = f + ".tmp"
+        if os.path.exists(tmp):
+            shutil.rmtree(tmp)
+        ckptr = ocp.PyTreeCheckpointer()
+        ckptr.save(tmp, {
+            "step": np.int64(step),
+            "params": state.params,
+            "opt_state": state.opt_state,
+            # tensorstore holds only numeric arrays; arbitrary metadata
+            # rides as a pickled byte array
+            "extra_pkl": np.frombuffer(
+                pickle.dumps(extra or {}), dtype=np.uint8
+            ).copy(),
+        })
+        if os.path.exists(f):
+            shutil.rmtree(f)
+        os.replace(tmp, f)  # atomic publish
+        return f
     payload = {
         "step": int(step),
         "params": _to_host(state.params),
@@ -44,37 +90,65 @@ def save_checkpoint(path: str, state, step: int,
 
 
 def latest_checkpoint(path: str) -> Optional[str]:
+    """Newest checkpoint under ``path`` (either backend's layout)."""
     if not os.path.isdir(path):
         return None
-    cands = [f for f in os.listdir(path)
-             if f.startswith("ckpt_") and f.endswith(".pkl")]
-    if not cands:
-        return None
-    step = max(int(f[5:-4]) for f in cands)
-    return os.path.join(path, f"ckpt_{step}.pkl")
+    best_step, best = -1, None
+    for f in os.listdir(path):
+        if not f.startswith("ckpt_") or f.endswith(".tmp"):
+            continue
+        stem = f[5:-4] if f.endswith(".pkl") else f[5:]
+        try:
+            step = int(stem)
+        except ValueError:
+            continue
+        if step > best_step:
+            best_step, best = step, os.path.join(path, f)
+    return best
 
 
 def load_checkpoint(path_or_file: str, state=None):
-    """Load a checkpoint; with ``state`` given, returns a new TrainState
-    with restored params/opt_state (tx reused), else the raw payload."""
+    """Load a checkpoint; with ``state`` given, returns
+    ``(TrainState, step)`` with restored params/opt_state (tx reused),
+    else the raw payload dict."""
     f = path_or_file
     if os.path.isdir(f):
-        f = latest_checkpoint(f)
-        if f is None:
+        # a checkpoint ROOT contains ckpt_<step> children; an orbax leaf
+        # contains the pytree keys themselves.  Resolve by content — the
+        # root's own name is irrelevant (it may itself start with ckpt_).
+        resolved = latest_checkpoint(f)
+        if resolved is not None:
+            f = resolved
+        elif not any(not e.startswith(".") for e in os.listdir(f)):
             raise FileNotFoundError(f"no checkpoints under {path_or_file}")
-    with open(f, "rb") as fh:
-        payload = pickle.load(fh)
+    if os.path.isdir(f):  # orbax layout
+        ocp = _orbax()
+        if ocp is None:
+            raise RuntimeError(f"{f} is an orbax checkpoint but orbax is "
+                               "not importable")
+        if state is not None:
+            # restore with the live structure so dtypes/shardings follow
+            # the running state (multi-host: shards land on their devices)
+            template = {
+                "step": np.int64(0),
+                "params": state.params,
+                "opt_state": state.opt_state,
+                "extra_pkl": np.zeros(0, np.uint8),
+            }
+            payload = ocp.PyTreeCheckpointer().restore(f, item=template)
+        else:
+            payload = ocp.PyTreeCheckpointer().restore(f)
+        if "extra_pkl" in payload:
+            raw = np.asarray(payload.pop("extra_pkl"), dtype=np.uint8)
+            payload["extra"] = (
+                pickle.loads(raw.tobytes()) if raw.size else {}
+            )
+    else:
+        with open(f, "rb") as fh:
+            payload = pickle.load(fh)
     if state is None:
         return payload
-    import jax
-
     from ..parallel.train import TrainState
 
-    params = jax.tree_util.tree_map(
-        lambda ref, new: np.asarray(new), state.params, payload["params"]
-    )
-    opt_state = jax.tree_util.tree_map(
-        lambda ref, new: np.asarray(new), state.opt_state,
-        payload["opt_state"]
-    )
-    return TrainState(params, opt_state, state.tx), payload["step"]
+    return (TrainState(payload["params"], payload["opt_state"], state.tx),
+            int(payload["step"]))

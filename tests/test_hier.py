@@ -1,0 +1,102 @@
+"""HierFeature: two-tier ICI x DCN exchange (VERDICT next #6).
+
+A [2, 4] mesh exercises BOTH axes (the round-1 gap: the DCN axis only ever
+appeared in its degenerate [1, n] form).
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import Mesh
+
+from quiver_tpu.dist.hier import HierFeature
+
+
+N, D = 600, 12
+HOT = 200  # rows [0, 200) are the hot tier
+
+
+def make_mesh():
+    devs = np.array(jax.devices()[:8]).reshape(2, 4)
+    return Mesh(devs, ("dcn", "ici"))
+
+
+def make_feature(mesh, rng, hot=HOT):
+    feat = rng.normal(size=(N, D)).astype(np.float32)
+    # cold tail partitioned half/half across the 2 hosts, interleaved so
+    # both hosts own rows everywhere in the range
+    g2h = (np.arange(N) % 2).astype(np.int32)
+    hf = HierFeature.from_global_feature(feat, mesh, hot_count=hot,
+                                         global2host=g2h)
+    return feat, g2h, hf
+
+
+def test_lookup_matches_ground_truth(rng):
+    mesh = make_mesh()
+    feat, g2h, hf = make_feature(mesh, rng)
+    B = 32
+    ids = rng.integers(0, N, (2, 4, B)).astype(np.int32)
+    out = np.asarray(hf.lookup(ids))
+    assert out.shape == (2, 4, B, D)
+    np.testing.assert_allclose(out, feat[ids], rtol=1e-6)
+    st = hf.traffic_stats()
+    assert st["drops"].sum() == 0  # default caps are exact
+
+
+def test_all_hot_never_crosses_dcn(rng):
+    mesh = make_mesh()
+    feat, g2h, hf = make_feature(mesh, rng)
+    ids = rng.integers(0, hf.hot_count, (2, 4, 16)).astype(np.int32)
+    out = np.asarray(hf.lookup(ids))
+    np.testing.assert_allclose(out, feat[ids], rtol=1e-6)
+    st = hf.traffic_stats()
+    # hot tier is replicated per host group: zero cross-host queries
+    assert st["dcn_crossings"].sum() == 0
+
+
+def test_skewed_workload_beats_flat_mesh(rng):
+    """Hot-heavy traffic rides ICI; a flat 8-partition mesh would ship
+    most queries cross-'host'. (The VERDICT #6 acceptance test.)"""
+    mesh = make_mesh()
+    feat, g2h, hf = make_feature(mesh, rng)
+    B = 64
+    # 80% hot ids, 20% cold — the shape real degree-skewed frontiers have
+    hot_ids = rng.integers(0, hf.hot_count, (2, 4, B))
+    cold_ids = rng.integers(hf.hot_count, N, (2, 4, B))
+    pick = rng.random((2, 4, B)) < 0.8
+    ids = np.where(pick, hot_ids, cold_ids).astype(np.int32)
+
+    out = np.asarray(hf.lookup(ids))
+    np.testing.assert_allclose(out, feat[ids], rtol=1e-6)
+    st = hf.traffic_stats()
+    hier_cross = int(st["dcn_crossings"].sum())
+
+    # flat comparison: 8 single-chip "hosts", range-partitioned — every
+    # query to a shard you don't own crosses the (would-be) DCN
+    flat_owner = (np.arange(N) * 8 // N).astype(np.int32)
+    me = np.arange(8).reshape(2, 4)[..., None] * np.ones((1, 1, B), int)
+    flat_cross = int((flat_owner[ids] != me).sum())
+
+    assert hier_cross < flat_cross, (hier_cross, flat_cross)
+    # and the expected magnitude: only cold misses cross (~20% * 1/2)
+    assert hier_cross <= 0.25 * ids.size, hier_cross
+    assert st["dcn_bytes_est"] == hier_cross * D * 4
+
+
+def test_overflow_counted_not_silent(rng):
+    mesh = make_mesh()
+    feat, g2h, hf = make_feature(mesh, rng)
+    hf.dcn_cap = 4  # force stage-1 overflow: every query is cold + remote
+    B = 32
+    # host 0 chips query ONLY host-1-owned cold ids -> 32 remote queries
+    # per chip vs capacity 4
+    cold = np.arange(hf.hot_count, N)
+    owned1 = cold[g2h[cold] == 1][:B]
+    ids = np.tile(owned1[None, None], (2, 4, 1)).astype(np.int32)
+    out = np.asarray(hf.lookup(ids))
+    st = hf.traffic_stats()
+    assert st["drops"].sum() > 0
+    # dropped queries return zero rows, never garbage
+    zero_rows = (out == 0).all(axis=-1)
+    assert zero_rows.sum() >= st["drops"].sum()

@@ -111,3 +111,21 @@ def test_uncapped_nodedup_zero_drops(small_graph):
     s = GraphSageSampler(small_graph, [4, 3], dedup="none")
     s.sample(np.arange(8, dtype=np.int64), key=jax.random.PRNGKey(0))
     assert (s.overflow_stats() == 0).all()
+
+
+def test_batch_carries_its_own_drop_counts(power_graph):
+    """SampledBatch.drops is attribution-safe under lookahead sampling
+    (sampler.last_drops is the NEXT batch's once a loader prefetches)."""
+    from quiver_tpu import GraphSageSampler
+
+    s = GraphSageSampler(power_graph, [6, 6], dedup="hop",
+                         frontier_caps=[40, 50])
+    b1 = s.sample(np.arange(32, dtype=np.int64), key=jax.random.PRNGKey(1))
+    drops1 = s.overflow_stats(b1)
+    # a second (lookahead) sample overwrites the sampler-level counter...
+    b2 = s.sample(np.arange(32, 64, dtype=np.int64),
+                  key=jax.random.PRNGKey(2))
+    # ...but batch-level attribution is stable
+    np.testing.assert_array_equal(s.overflow_stats(b1), drops1)
+    assert s.overflow_stats(b2).shape == (2,)
+    np.testing.assert_array_equal(s.overflow_stats(), s.overflow_stats(b2))

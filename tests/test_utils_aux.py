@@ -123,3 +123,19 @@ def test_config_env_and_update(monkeypatch):
     with _pytest.raises(AttributeError):
         cfg_mod.update(nope=1)
     monkeypatch.setattr(cfg_mod, "_config", None)
+
+
+def test_checkpoint_root_named_ckpt_prefix(tmp_path):
+    """A root dir whose own name starts with ckpt_ still resolves to its
+    newest child (content-based, not name-based, detection)."""
+    import jax.numpy as jnp
+    import optax
+
+    from quiver_tpu.parallel import TrainState
+
+    root = tmp_path / "ckpt_run1"
+    tx = optax.adam(1e-3)
+    state = TrainState.create({"w": jnp.ones(4)}, tx)
+    save_checkpoint(str(root), state, step=5)
+    state2, step = load_checkpoint(str(root), state)
+    assert step == 5
