@@ -371,7 +371,10 @@ class Feature:
                     self._pending.pop(next(iter(self._pending)))
 
         self._inflight.append(self._pool.submit(work))
-        while len(self._inflight) > 8:  # done futures age out naturally
+        # age out only FINISHED futures: dropping a pending one would break
+        # _take_staged's FIFO-drain (its key could never be waited for,
+        # forcing a duplicate synchronous gather)
+        while len(self._inflight) > 8 and self._inflight[0].done():
             self._inflight.popleft()
 
     def lookup_device(self, idx):

@@ -23,16 +23,21 @@ import numpy as np
 __all__ = ["full_graph_inference"]
 
 
-def _edge_stream(indptr_np, n, edge_chunk):
-    """Yield (lo, hi, rows) chunks of the edge array; rows = target node
-    of each edge (CSR row expansion, host-side once)."""
+def _make_edge_stream(indptr_np, n, edge_chunk):
+    """Build the CSR row expansion ONCE (E can be 10^8: ~1 GB host array)
+    and return a re-iterable stream of (lo, hi, rows-on-device) chunks —
+    every layer of every model walks the same chunks."""
     row_of_edge = np.repeat(
         np.arange(n, dtype=np.int64), indptr_np[1:] - indptr_np[:-1]
     )
     e_total = len(row_of_edge)
-    for lo in range(0, e_total, edge_chunk):
-        hi = min(lo + edge_chunk, e_total)
-        yield lo, hi, jnp.asarray(row_of_edge[lo:hi])
+
+    def stream():
+        for lo in range(0, e_total, edge_chunk):
+            hi = min(lo + edge_chunk, e_total)
+            yield lo, hi, jnp.asarray(row_of_edge[lo:hi])
+
+    return stream
 
 
 @jax.jit
@@ -45,10 +50,9 @@ def _seg_max(acc, vals, rows):
     return acc.at[rows].max(vals)
 
 
-def _mean_aggregate(h, indptr_np, indices_dev, deg, edge_chunk):
-    n = h.shape[0]
+def _mean_aggregate(h, edge_stream, indices_dev, deg):
     acc = jnp.zeros_like(h)
-    for lo, hi, rows in _edge_stream(indptr_np, n, edge_chunk):
+    for lo, hi, rows in edge_stream():
         acc = _seg_add(acc, jnp.take(h, indices_dev[lo:hi], axis=0), rows)
     return acc / jnp.maximum(deg, 1.0)[:, None]
 
@@ -93,12 +97,12 @@ def full_graph_inference(model, params=None, x=None, indptr=None,
     indices_dev = jnp.asarray(np.asarray(indices)[: int(indptr_np[-1])])
     deg = jnp.asarray((indptr_np[1:] - indptr_np[:-1]).astype(np.float32))
     x = jnp.asarray(x)
+    edge_stream = _make_edge_stream(indptr_np, n, edge_chunk)
 
     if isinstance(model, GraphSAGE):
         for i in range(model.num_layers):
             conv = p[f"conv{i}"]
-            mean_nbr = _mean_aggregate(x, indptr_np, indices_dev, deg,
-                                       edge_chunk)
+            mean_nbr = _mean_aggregate(x, edge_stream, indices_dev, deg)
             x = (x @ jnp.asarray(conv["lin_self"]["kernel"])
                  + jnp.asarray(conv["lin_self"]["bias"])
                  + mean_nbr @ jnp.asarray(conv["lin_nbr"]["kernel"]))
@@ -116,7 +120,7 @@ def full_graph_inference(model, params=None, x=None, indptr=None,
             w = x @ jnp.asarray(lin["kernel"]) + jnp.asarray(lin["bias"])
             acc = jnp.zeros_like(w)
             wn = w * norm[:, None]
-            for lo, hi, rows in _edge_stream(indptr_np, n, edge_chunk):
+            for lo, hi, rows in edge_stream():
                 acc = _seg_add(
                     acc, jnp.take(wn, indices_dev[lo:hi], axis=0), rows
                 )
@@ -141,7 +145,7 @@ def full_graph_inference(model, params=None, x=None, indptr=None,
             e_self = jax.nn.leaky_relu(e_src_all + e_tgt_all, slope)
             # pass 1: streaming segment-max of edge scores (incl. self)
             m = e_self
-            for lo, hi, rows in _edge_stream(indptr_np, n, edge_chunk):
+            for lo, hi, rows in edge_stream():
                 e = jax.nn.leaky_relu(
                     jnp.take(e_src_all, indices_dev[lo:hi], axis=0)
                     + jnp.take(e_tgt_all, rows, axis=0), slope)
@@ -149,7 +153,7 @@ def full_graph_inference(model, params=None, x=None, indptr=None,
             # pass 2: accumulate exp(e - m_v) * w_u and the denominator
             num = jnp.exp(e_self - m)[..., None] * w   # self-loop term
             den = jnp.exp(e_self - m)
-            for lo, hi, rows in _edge_stream(indptr_np, n, edge_chunk):
+            for lo, hi, rows in edge_stream():
                 cols = indices_dev[lo:hi]
                 e = jax.nn.leaky_relu(
                     jnp.take(e_src_all, cols, axis=0)

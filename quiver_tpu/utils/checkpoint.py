@@ -113,13 +113,13 @@ def load_checkpoint(path_or_file: str, state=None):
     else the raw payload dict."""
     f = path_or_file
     if os.path.isdir(f):
-        # a checkpoint ROOT contains ckpt_<step> children; an orbax leaf
-        # contains the pytree keys themselves.  Resolve by content — the
+        # a checkpoint ROOT contains ckpt_<step> children; an orbax LEAF
+        # carries orbax's metadata marker.  Resolve by content — the
         # root's own name is irrelevant (it may itself start with ckpt_).
         resolved = latest_checkpoint(f)
         if resolved is not None:
             f = resolved
-        elif not any(not e.startswith(".") for e in os.listdir(f)):
+        elif not os.path.exists(os.path.join(f, "_CHECKPOINT_METADATA")):
             raise FileNotFoundError(f"no checkpoints under {path_or_file}")
     if os.path.isdir(f):  # orbax layout
         ocp = _orbax()

@@ -1,0 +1,37 @@
+"""Scaled distributed-training evidence (VERDICT next #7): 100K nodes,
+reference fanout [15,10,5], full dist stack on the virtual 8-mesh, loss
+decreases over 20+ steps, zero silent drops at exact caps."""
+
+import numpy as np
+import pytest
+
+from quiver_tpu.dist.e2e import run_dist_training
+
+pytestmark = pytest.mark.slow
+
+
+def test_dist_training_100k_loss_decreases():
+    out = run_dist_training(
+        n_devices=8, n_nodes=100_000, avg_deg=12, feat_dim=16,
+        batch_per_dev=32, sizes=[15, 10, 5], steps=24, classes=8,
+        lr=3e-3, seed=7,
+    )
+    losses = out["losses"]
+    assert len(losses) == 24
+    assert all(np.isfinite(l) for l in losses), losses
+    early = float(np.mean(losses[:5]))
+    late = float(np.mean(losses[-5:]))
+    assert late < early, (early, late, losses)
+    # exact caps: nothing silently dropped anywhere in the stack
+    assert out["sampler_overflow"].sum() == 0, out["sampler_overflow"]
+    assert out["feature_overflow"] == 0
+
+
+def test_dist_training_quick_smoke():
+    """Small config (the dryrun shape) stays healthy — quick variant."""
+    out = run_dist_training(n_devices=8, n_nodes=2_000, avg_deg=8,
+                            feat_dim=8, batch_per_dev=8, sizes=[5, 4],
+                            steps=3, seed=1)
+    assert all(np.isfinite(l) for l in out["losses"])
+    assert out["sampler_overflow"].sum() == 0
+    assert out["feature_overflow"] == 0
