@@ -111,7 +111,7 @@ def pick_gather_mode(topo, batch_size, sizes):
 
 
 def bench_sampling(topo, batch_size, sizes, iters, gather_mode,
-                   dedup="none", warmup=3):
+                   dedup="none", warmup=3, uva_budget=None):
     import jax
 
     from quiver_tpu import GraphSageSampler
@@ -125,8 +125,10 @@ def bench_sampling(topo, batch_size, sizes, iters, gather_mode,
         for k in sizes:
             p = p * (1 + k)
             caps.append(max(batch_size + 1, int(p * 0.5)))
+    mode = "UVA" if uva_budget is not None else "TPU"
     sampler = GraphSageSampler(topo, sizes, gather_mode=gather_mode,
-                               dedup=dedup, frontier_caps=caps)
+                               dedup=dedup, frontier_caps=caps,
+                               mode=mode, uva_budget=uva_budget)
     n = topo.node_count
     rng = np.random.default_rng(3)
     seed_batches = [
@@ -390,6 +392,10 @@ def main():
     _watchdog(600.0, stage)
     import jax
 
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # the axon site hook re-exports JAX_PLATFORMS after env setup; the
+        # config API takes final precedence (same pin as tests/conftest.py)
+        jax.config.update("jax_platforms", "cpu")
     jax.devices()  # force device init under the watchdog
     stage["device_ready"] = True
 
@@ -418,6 +424,15 @@ def main():
         if args.ab_dedup:
             sections["sampling_dedup_hop"] = bench_sampling(
                 topo, best["batch"], FANOUT, args.iters, gm, dedup="hop")
+        try:
+            # UVA tier: 1/3 of the edge array in HBM, rest on host
+            r = bench_sampling(topo, best["batch"], FANOUT,
+                               max(args.iters // 2, 5), gm,
+                               uva_budget=topo.edge_count * 4 // 3)
+            r["hbm_frac"] = 0.33
+            sections["sampling_uva"] = r
+        except Exception as e:
+            log(f"uva bench failed: {type(e).__name__}: {e}")
 
     if "feature" in want:
         try:

@@ -32,6 +32,9 @@ from .dist.comm import TpuComm
 from .dist.sampler import DistGraphSampler
 from .dist.ring import RingFeature
 from .dist.init import initialize as distributed_initialize, make_hybrid_mesh
+from .dist.hier import HierFeature
+from .uva import UVAGraph
+from .utils.rng import make_key
 from .partition import (
     partition_without_replication,
     quiver_partition_feature,
@@ -65,6 +68,7 @@ __all__ = [
     "Feature", "DeviceConfig",
     "DistFeature", "PartitionInfo", "TpuComm", "DistGraphSampler",
     "RingFeature", "distributed_initialize", "make_hybrid_mesh",
+    "HierFeature", "UVAGraph", "make_key",
     "partition_without_replication", "quiver_partition_feature",
     "load_quiver_feature_partition",
     "generate_neighbour_num",

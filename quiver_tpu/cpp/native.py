@@ -118,7 +118,11 @@ class CPUSampler:
         return (self._seed * 1_000_003 + self._ctr) & (2**64 - 1)
 
     def sample_neighbors(self, seeds: np.ndarray, k: int,
-                         seed_mask: Optional[np.ndarray] = None):
+                         seed_mask: Optional[np.ndarray] = None,
+                         seed: Optional[int] = None):
+        """``seed`` overrides the internal counter-derived RNG seed so
+        callers holding a jax key can make the host tier reproducible
+        (``uva.sample_uva``)."""
         seeds = np.ascontiguousarray(seeds, dtype=np.int32)
         B = len(seeds)
         nbrs = np.empty((B, k), dtype=np.int32)
@@ -129,13 +133,14 @@ class CPUSampler:
             else np.ascontiguousarray(seed_mask, dtype=np.uint8)
         )
         lib = _get_lib()
+        rng_seed = seed if seed is not None else self._next_seed()
         if lib is not None and self.cum_weights is not None:
             lib.qt_sample_weighted(
                 self.indptr, self.indices, self.cum_weights, seeds,
-                _as_u8_ptr(sm), B, k, self._next_seed(), self.n_threads,
+                _as_u8_ptr(sm), B, k, rng_seed, self.n_threads,
                 nbrs.reshape(-1), mask.reshape(-1), counts)
         elif self.cum_weights is not None:  # numpy weighted fallback
-            rng = np.random.default_rng(self._next_seed() % 2**32)
+            rng = np.random.default_rng(rng_seed % 2**32)
             cw = self.cum_weights
             for b in range(B):
                 if sm is not None and not sm[b]:
@@ -156,10 +161,10 @@ class CPUSampler:
             return nbrs, mask.astype(bool), counts
         elif lib is not None:
             lib.qt_sample(self.indptr, self.indices, seeds, _as_u8_ptr(sm),
-                          B, k, self._next_seed(), self.n_threads,
+                          B, k, rng_seed, self.n_threads,
                           nbrs.reshape(-1), mask.reshape(-1), counts)
         else:  # numpy fallback
-            rng = np.random.default_rng(self._next_seed() % 2**32)
+            rng = np.random.default_rng(rng_seed % 2**32)
             for b in range(B):
                 if sm is not None and not sm[b]:
                     counts[b] = 0

@@ -22,7 +22,7 @@ sit far below the no-dedup bound, so a cap ~2x the typical frontier loses
 
 from __future__ import annotations
 
-from typing import NamedTuple, Optional, Sequence, Tuple
+from typing import NamedTuple, Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -233,10 +233,13 @@ class GraphSageSampler:
                  mode: str = "TPU",
                  frontier_caps: Optional[Sequence[Optional[int]]] = None,
                  dedup: str = "none", gather_mode: str = "auto",
-                 edge_weights=None, return_eid: bool = False):
+                 edge_weights=None, return_eid: bool = False,
+                 uva_budget: Union[int, str, None] = None):
         assert mode in ("TPU", "CPU", "UVA", "GPU"), mode
-        if mode in ("UVA", "GPU"):  # compat aliases from the reference API
+        if mode == "GPU":  # compat alias from the reference API
             mode = "TPU"
+        if mode == "UVA" and uva_budget is None:
+            mode = "TPU"  # whole graph fits the (unbounded) budget
         assert dedup in ("none", "hop"), dedup
         assert gather_mode in ("auto", "xla", "lanes", "lanes_fused",
                                "pallas"), gather_mode
@@ -268,6 +271,15 @@ class GraphSageSampler:
         self._jitted = {}  # batch_size -> compiled pipeline (mixed-size
         # workloads — e.g. serving buckets — must not evict each other)
         self._cpu = None
+        self.uva_budget = uva_budget
+        self._uva = None
+        if mode == "UVA":
+            assert dedup == "none", "UVA mode: positional pipeline only"
+            assert edge_weights is None, "UVA mode: uniform sampling only"
+            assert not return_eid, (
+                "UVA mode: hot-tier edge positions are sub-CSR local, so "
+                "global eids are unavailable; use TPU or CPU mode"
+            )
         self._cum_weights = None
         self._edge_weights = edge_weights
         if edge_weights is not None and mode == "TPU":
@@ -339,6 +351,8 @@ class GraphSageSampler:
         """
         if self.mode == "CPU":
             return self._sample_cpu(input_nodes)
+        if self.mode == "UVA":
+            return self._sample_uva(input_nodes, key)
         if isinstance(input_nodes, jax.Array):  # stay on device
             seeds = input_nodes.astype(jnp.int32)
         else:
@@ -378,6 +392,32 @@ class GraphSageSampler:
         if getattr(self, "last_drops", None) is None:
             return None
         return np.asarray(self.last_drops)
+
+    def _sample_uva(self, input_nodes, key) -> SampledBatch:
+        """Hot/cold big-graph sampling (``quiver_tpu.uva``): HBM-budgeted
+        hot rows on device, cold rows on the native host sampler,
+        overlapped per hop."""
+        from .uva import UVAGraph, sample_uva
+
+        if self._uva is None:
+            self._uva = UVAGraph(self.csr_topo, self.uva_budget)
+        if key is None:
+            from .utils.rng import make_key
+
+            key = make_key(np.random.randint(0, 2**31 - 1))
+        gm = self.gather_mode
+        n_id, n_mask, num, blocks = sample_uva(
+            self._uva, self.sizes, input_nodes, key, gather_mode=gm
+        )
+        return SampledBatch(
+            n_id=jnp.asarray(n_id), n_id_mask=jnp.asarray(n_mask),
+            num_nodes=jnp.asarray(num), batch_size=len(input_nodes),
+            layers=tuple(
+                LayerBlock(jnp.asarray(nl), jnp.asarray(m),
+                           jnp.asarray(t))
+                for nl, m, t in blocks
+            ),
+        )
 
     def _sample_cpu(self, input_nodes) -> SampledBatch:
         from .cpp import native

@@ -7,9 +7,8 @@ import pytest
 
 from quiver_tpu.dist.e2e import run_dist_training
 
-pytestmark = pytest.mark.slow
 
-
+@pytest.mark.slow
 def test_dist_training_100k_loss_decreases():
     out = run_dist_training(
         n_devices=8, n_nodes=100_000, avg_deg=12, feat_dim=16,
