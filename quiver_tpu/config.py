@@ -75,7 +75,8 @@ def _load_tuned(cfg: Config):
     except Exception:
         return
     if (cfg.gather_mode == "auto"
-            and tuned.get("gather_mode") in ("xla", "lanes", "lanes_fused")):
+            and tuned.get("gather_mode") in ("xla", "lanes", "lanes_fused",
+                                             "pallas")):
         cfg.gather_mode = tuned["gather_mode"]
 
 
