@@ -103,6 +103,52 @@ def main():
 
     stage("3-hop xla + rbg (small graph)", 300, lambda: hop3("xla"))
     stage("3-hop pallas + rbg (small graph)", 300, lambda: hop3("pallas"))
+
+    # ---- cold-tier placement experiment: can the TPU gather rows from a
+    # host-memory-kind array under jit (the true zero-copy analogue)?
+    def pinned_host_gather():
+        from jax.sharding import SingleDeviceSharding
+
+        dev = jax.devices()[0]
+        rows = np.random.default_rng(0).normal(
+            size=(200_000, 128)).astype(np.float32)
+        try:
+            host_shard = SingleDeviceSharding(dev, memory_kind="pinned_host")
+        except TypeError:
+            return "SingleDeviceSharding has no memory_kind — skip"
+        arr = jax.device_put(rows, host_shard)
+        idx = jnp.asarray(np.random.default_rng(1).integers(
+            0, 200_000, 50_000, dtype=np.int32))
+
+        @jax.jit
+        def take(a, i):
+            return jnp.take(a, i, axis=0)
+
+        out = take(arr, idx)
+        out.block_until_ready()
+        t0 = time.perf_counter()
+        for _ in range(5):
+            out = take(arr, idx)
+        out.block_until_ready()
+        dt = (time.perf_counter() - t0) / 5
+        gbs = 50_000 * 128 * 4 / dt / 1e9
+        return f"pinned_host gather {gbs:.2f} GB/s ({dt * 1e3:.1f} ms)"
+
+    stage("pinned_host cold gather", 240, pinned_host_gather)
+
+    def host_roundtrip_gather():
+        rows = np.random.default_rng(0).normal(
+            size=(200_000, 128)).astype(np.float32)
+        idx = np.random.default_rng(1).integers(0, 200_000, 50_000)
+        t0 = time.perf_counter()
+        for _ in range(5):
+            out = jnp.asarray(rows[idx])
+        out.block_until_ready()
+        dt = (time.perf_counter() - t0) / 5
+        return (f"host-gather+H2D {50_000 * 128 * 4 / dt / 1e9:.2f} GB/s "
+                f"({dt * 1e3:.1f} ms)")
+
+    stage("host numpy gather + upload", 240, host_roundtrip_gather)
     log("DIAGNOSE DONE")
 
 

@@ -350,6 +350,7 @@ class Feature:
         if self.cache_count >= self.node_count:
             return  # nothing host-side to hide
         if self._pool is None:
+            import atexit
             import collections
             import threading
             from concurrent.futures import ThreadPoolExecutor
@@ -357,6 +358,11 @@ class Feature:
             self._pool = ThreadPoolExecutor(
                 max_workers=1, thread_name_prefix="feature-prefetch"
             )
+            # cancel queued stages at interpreter exit: a straggler
+            # worker touching jax arrays during runtime teardown aborts
+            # the process (C++ terminate)
+            atexit.register(self._pool.shutdown, wait=False,
+                            cancel_futures=True)
             self._plock = threading.Lock()
             self._inflight = collections.deque()
 
