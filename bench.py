@@ -53,6 +53,43 @@ def _watchdog(seconds: float, stage: dict):
     return t
 
 
+class _SectionTimeout(Exception):
+    pass
+
+
+class _bounded:
+    """SIGALRM bound around one bench section: a pathological compile
+    (round 1 lost its whole TPU window to one) skips the section instead
+    of eating the run — the final JSON line always prints."""
+
+    def __init__(self, name: str, seconds: int):
+        self.name, self.seconds = name, seconds
+
+    def __enter__(self):
+        import signal
+
+        def onalarm(sig, frm):
+            raise _SectionTimeout(self.name)
+
+        self._old = signal.signal(signal.SIGALRM, onalarm)
+        signal.alarm(self.seconds)
+        return self
+
+    def __exit__(self, et, ev, tb):
+        import signal
+
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, self._old)
+        if et is _SectionTimeout:
+            log(f"SECTION TIMEOUT ({self.name} > {self.seconds}s) — "
+                "skipping")
+            return True
+        if et is not None:
+            log(f"section {self.name} failed: {et.__name__}: {ev}")
+            return True
+        return False
+
+
 
 
 def _mk(seed):
@@ -411,53 +448,52 @@ def main():
     sections = {}
     seps = 0.0
     if "sampling" in want:
-        gm = pick_gather_mode(topo, batches[0], FANOUT)
+        gm = "xla"
+        with _bounded("gather-probe", 900):
+            gm = pick_gather_mode(topo, batches[0], FANOUT)
         best = None
         for b in batches:
-            r = bench_sampling(topo, b, FANOUT, args.iters, gm)
-            if best is None or r["seps"] > best["seps"]:
-                best = r
-        best["gather_mode"] = gm
-        best["vs_baseline"] = round(best["seps"] / BASELINE_SEPS, 3)
-        sections["sampling"] = best
-        seps = best["seps"]
+            with _bounded(f"sampling-B{b}", 900):
+                r = bench_sampling(topo, b, FANOUT, args.iters, gm)
+                if best is None or r["seps"] > best["seps"]:
+                    best = r
+        if best is not None:
+            best["gather_mode"] = gm
+            best["vs_baseline"] = round(best["seps"] / BASELINE_SEPS, 3)
+            sections["sampling"] = best
+            seps = best["seps"]
+        bb = best["batch"] if best else batches[0]
         if args.ab_dedup:
-            sections["sampling_dedup_hop"] = bench_sampling(
-                topo, best["batch"], FANOUT, args.iters, gm, dedup="hop")
-        try:
+            with _bounded("sampling-dedup-hop", 900):
+                sections["sampling_dedup_hop"] = bench_sampling(
+                    topo, bb, FANOUT, args.iters, gm, dedup="hop")
+        with _bounded("sampling-uva", 900):
             # UVA tier: 1/3 of the edge array in HBM, rest on host
-            r = bench_sampling(topo, best["batch"], FANOUT,
+            r = bench_sampling(topo, bb, FANOUT,
                                max(args.iters // 2, 5), gm,
                                uva_budget=topo.edge_count * 4 // 3)
             r["hbm_frac"] = 0.33
             sections["sampling_uva"] = r
-        except Exception as e:
-            log(f"uva bench failed: {type(e).__name__}: {e}")
 
     if "feature" in want:
-        try:
-            sections["feature"] = bench_feature(n_nodes, feat_dim, feat_rows)
-        except Exception as e:
-            log(f"feature bench failed: {type(e).__name__}: {e}")
+        with _bounded("feature", 600):
+            sections["feature"] = bench_feature(n_nodes, feat_dim,
+                                                feat_rows)
 
     if "e2e" in want:
-        try:
-            sections["e2e"] = bench_e2e(topo, feat_dim, classes,
-                                        1024 if not args.small else 256,
+        B = 1024 if not args.small else 256
+        with _bounded("e2e", 1200):
+            sections["e2e"] = bench_e2e(topo, feat_dim, classes, B,
                                         e2e_steps)
-            if args.ab_dedup:
+        if args.ab_dedup:
+            with _bounded("e2e-dedup-hop", 1200):
                 sections["e2e_dedup_hop"] = bench_e2e(
-                    topo, feat_dim, classes,
-                    1024 if not args.small else 256, e2e_steps, dedup="hop")
-        except Exception as e:
-            log(f"e2e bench failed: {type(e).__name__}: {e}")
+                    topo, feat_dim, classes, B, e2e_steps, dedup="hop")
 
     if "serving" in want:
-        try:
+        with _bounded("serving", 900):
             sections["serving"] = bench_serving(topo, feat_dim, classes,
                                                 n_requests)
-        except Exception as e:
-            log(f"serving bench failed: {type(e).__name__}: {e}")
 
     headline = sections.get("sampling", {}).get("seps", seps)
     print(json.dumps({
