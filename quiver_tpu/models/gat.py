@@ -24,6 +24,7 @@ class GAT(nn.Module):
     num_layers: int = 2
     heads: int = 4
     dropout: float = 0.5
+    dtype: object = None
 
     @nn.compact
     def __call__(self, x: jax.Array, blocks: Tuple[LayerBlock, ...],
@@ -35,6 +36,7 @@ class GAT(nn.Module):
                 self.out_dim if last else self.hidden,
                 heads=1 if last else self.heads,
                 concat=not last,
+                dtype=self.dtype,
                 name=f"gat{i}",
             )(x, blk)
             if not last:

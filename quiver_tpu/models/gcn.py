@@ -23,11 +23,13 @@ __all__ = ["GCNConv", "GCN"]
 
 class GCNConv(nn.Module):
     features: int
+    dtype: object = None
 
     @nn.compact
     def __call__(self, x: jax.Array, block: LayerBlock) -> jax.Array:
         t = block.nbr_local.shape[0]
-        w = nn.Dense(self.features, use_bias=True, name="lin")(x)
+        w = nn.Dense(self.features, use_bias=True, dtype=self.dtype,
+                     name="lin")(x)
         w_src = jnp.take(w, block.nbr_local, axis=0)        # [T, k, F]
         m = block.mask.astype(x.dtype)[..., None]
         deg = block.mask.sum(axis=1).astype(x.dtype)        # [T]
@@ -43,6 +45,7 @@ class GCN(nn.Module):
     out_dim: int
     num_layers: int = 2
     dropout: float = 0.5
+    dtype: object = None
 
     @nn.compact
     def __call__(self, x: jax.Array, blocks: Tuple[LayerBlock, ...],
@@ -51,7 +54,7 @@ class GCN(nn.Module):
         for i, blk in enumerate(blocks):
             last = i == self.num_layers - 1
             x = GCNConv(self.out_dim if last else self.hidden,
-                        name=f"gcn{i}")(x, blk)
+                        dtype=self.dtype, name=f"gcn{i}")(x, blk)
             if not last:
                 x = nn.relu(x)
                 x = nn.Dropout(self.dropout, deterministic=not train)(x)

@@ -24,10 +24,14 @@ class SAGEConv(nn.Module):
     """GraphSAGE mean aggregator: ``W_self x + W_nbr mean(x_N(v))``.
 
     Math parity with PyG's SAGEConv as used in the reference examples.
+    ``dtype=jnp.bfloat16`` runs the matmuls on the MXU's native format
+    (params stay float32; activations/compute cast — the standard TPU
+    mixed-precision recipe).
     """
 
     features: int
     use_bias: bool = True
+    dtype: object = None
 
     @nn.compact
     def __call__(self, x: jax.Array, block: LayerBlock) -> jax.Array:
@@ -38,9 +42,9 @@ class SAGEConv(nn.Module):
         mean_nbr = (x_src * m).sum(axis=1) / cnt            # [T, D]
         x_tgt = x[:t]
         out = nn.Dense(self.features, use_bias=self.use_bias,
-                       name="lin_self")(x_tgt)
+                       dtype=self.dtype, name="lin_self")(x_tgt)
         out = out + nn.Dense(self.features, use_bias=False,
-                             name="lin_nbr")(mean_nbr)
+                             dtype=self.dtype, name="lin_nbr")(mean_nbr)
         return out
 
 
@@ -55,12 +59,14 @@ class GATConv(nn.Module):
     heads: int = 1
     concat: bool = True
     negative_slope: float = 0.2
+    dtype: object = None
 
     @nn.compact
     def __call__(self, x: jax.Array, block: LayerBlock) -> jax.Array:
         h, f = self.heads, self.features
         t = block.nbr_local.shape[0]
-        w = nn.Dense(h * f, use_bias=False, name="lin")(x)
+        w = nn.Dense(h * f, use_bias=False, dtype=self.dtype,
+                     name="lin")(x)
         w = w.reshape(x.shape[0], h, f)
         w_src = jnp.take(w, block.nbr_local, axis=0)         # [T, k, H, F]
         w_tgt = w[:t]                                        # [T, H, F]

@@ -25,6 +25,7 @@ class GraphSAGE(nn.Module):
     out_dim: int
     num_layers: int = 3
     dropout: float = 0.5
+    dtype: object = None  # e.g. jnp.bfloat16 for MXU-native matmuls
 
     @nn.compact
     def __call__(self, x: jax.Array, blocks: Tuple[LayerBlock, ...],
@@ -34,7 +35,7 @@ class GraphSAGE(nn.Module):
         )
         for i, blk in enumerate(blocks):
             dim = self.out_dim if i == self.num_layers - 1 else self.hidden
-            x = SAGEConv(dim, name=f"conv{i}")(x, blk)
+            x = SAGEConv(dim, dtype=self.dtype, name=f"conv{i}")(x, blk)
             if i != self.num_layers - 1:
                 x = nn.relu(x)
                 x = nn.Dropout(self.dropout, deterministic=not train)(x)

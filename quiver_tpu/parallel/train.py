@@ -79,7 +79,9 @@ def make_train_step(apply_fn: Callable, tx: optax.GradientTransformation,
         return TrainState(params, opt_state, state.tx), loss
 
     if mesh is None:
-        return jax.jit(step)
+        # donate the state: params/opt_state buffers update in place on
+        # device instead of being copied every step
+        return jax.jit(step, donate_argnums=(0,))
 
     # Data-parallel variant: the batch pytree is STACKED on a leading
     # replica axis of size mesh.shape[data_axis] (each replica sampled its
@@ -109,6 +111,7 @@ def make_train_step(apply_fn: Callable, tx: optax.GradientTransformation,
     data = NamedSharding(mesh, P(data_axis))
     return jax.jit(
         dp_step,
+        donate_argnums=(0,),
         in_shardings=(repl, data, data, data, data, repl),
         out_shardings=(repl, repl),
     )

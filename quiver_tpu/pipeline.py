@@ -13,6 +13,7 @@ fall back to the two-stage loop (``SeedLoader``).
 
 from __future__ import annotations
 
+import functools
 from typing import Callable, Optional
 
 import jax
@@ -55,7 +56,7 @@ def make_fused_train_step(sampler: GraphSageSampler, feature: Feature,
             m = mask.astype(ls.dtype)
             return (ls * m).sum() / jnp.maximum(m.sum(), 1.0)
 
-    @jax.jit
+    @functools.partial(jax.jit, donate_argnums=(0,))
     def step(state: TrainState, seeds, labels, label_mask, key):
         ks, kd = jax.random.split(key)
         n_id, n_mask, num, blocks, _ = run_pipeline(

@@ -269,3 +269,40 @@ def test_full_graph_inference_gat_matches_full_fanout_blocks(small_graph,
         small_graph.indices, edge_chunk=200
     ))
     np.testing.assert_allclose(sampled, exact, rtol=2e-4, atol=2e-5)
+
+
+def test_bfloat16_models_train(small_graph, rng):
+    """dtype=bfloat16 models: finite outputs, loss decreases, params
+    stay float32 (mixed precision, the MXU recipe)."""
+    import optax
+    from quiver_tpu.models import GraphSAGE
+    from quiver_tpu import GraphSageSampler
+
+    n = small_graph.node_count
+    x0 = jnp.asarray(rng.normal(size=(n, 8)).astype(np.float32))
+    model = GraphSAGE(hidden=16, out_dim=4, num_layers=2, dropout=0.0,
+                      dtype=jnp.bfloat16)
+    s = GraphSageSampler(small_graph, [4, 3])
+    b = s.sample(np.arange(16, dtype=np.int64))
+    params = model.init(jax.random.PRNGKey(0), x0[b.n_id], b.layers)
+    leaves = jax.tree_util.tree_leaves(params)
+    assert all(l.dtype == jnp.float32 for l in leaves)
+    out = model.apply(params, x0[b.n_id], b.layers)
+    assert out.dtype == jnp.bfloat16
+    assert bool(jnp.isfinite(out.astype(jnp.float32)).all())
+
+    tx = optax.adam(1e-2)
+    opt = tx.init(params)
+    labels = jnp.asarray(rng.integers(0, 4, 16))
+
+    def loss_fn(p):
+        logits = model.apply(p, x0[b.n_id], b.layers).astype(jnp.float32)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits[:16], labels).mean()
+
+    l0 = float(loss_fn(params))
+    for _ in range(8):
+        g = jax.grad(loss_fn)(params)
+        upd, opt = tx.update(g, opt, params)
+        params = optax.apply_updates(params, upd)
+    assert float(loss_fn(params)) < l0
