@@ -27,19 +27,26 @@ def run_dist_training(n_devices: int, n_nodes: int = 256,
                       sizes: Sequence[int] = (4, 3),
                       steps: int = 1, classes: int = 8,
                       lr: float = 3e-3, seed: int = 0,
-                      learnable_labels: bool = True):
+                      learnable_labels: bool = True,
+                      hier: Optional[tuple] = None):
     """Run ``steps`` DP training steps over an ``n_devices`` mesh.
 
     Returns a dict with per-step ``losses``, the sampler's summed overflow
-    counts, and the DistFeature overflow counts — callers assert on them.
-    Labels are a linear function of the features by default so the loss
-    can actually decrease (random labels can't prove learning).
+    counts, and the feature-store overflow counts — callers assert on
+    them.  Labels are a linear function of the features by default so the
+    loss can actually decrease (random labels can't prove learning).
+
+    ``hier=(n_hosts, hot_frac)`` swaps the flat DistFeature for the
+    two-tier :class:`HierFeature` over a ``[n_hosts, n_devices/n_hosts]``
+    DCN x ICI mesh (degree-ordered hot set); the result dict then also
+    carries summed ``dcn_crossings``.
     """
     import jax
     import jax.numpy as jnp
     import optax
 
     from quiver_tpu import CSRTopo, DistFeature, PartitionInfo
+    from quiver_tpu.dist.hier import HierFeature
     from quiver_tpu.dist.sampler import DistGraphSampler
     from quiver_tpu.models import GraphSAGE
     from quiver_tpu.parallel import TrainState, make_train_step
@@ -58,9 +65,32 @@ def run_dist_training(n_devices: int, n_nodes: int = 256,
         labels = rng.integers(0, classes, n_nodes).astype(np.int32)
 
     mesh = make_mesh(("data",), devices=jax.devices()[:n_devices])
-    g2h = rng.integers(0, n_devices, topo.node_count).astype(np.int32)
-    info = PartitionInfo(host=0, hosts=n_devices, global2host=g2h)
-    dist_feat = DistFeature.from_global_feature(feat, mesh, info)
+    hier_feat = None
+    if hier is not None:
+        from jax.sharding import Mesh
+
+        n_hosts, hot_frac = hier
+        C = n_devices // n_hosts
+        hmesh = Mesh(
+            np.array(jax.devices()[:n_devices]).reshape(n_hosts, C),
+            ("dcn", "ici"),
+        )
+        # degree-descending order so the hot tier holds the high-traffic
+        # rows; the sampler keeps GLOBAL ids, so remap at lookup time
+        order = np.argsort(-topo.degree, kind="stable")
+        old2new = np.empty(n_nodes, dtype=np.int32)
+        old2new[order] = np.arange(n_nodes, dtype=np.int32)
+        hot_count = int(n_nodes * hot_frac)
+        g2h_hier = (np.arange(n_nodes) % n_hosts).astype(np.int32)
+        hier_feat = HierFeature.from_global_feature(
+            feat[order], hmesh, hot_count=hot_count,
+            global2host=g2h_hier)
+        hier_old2new = old2new
+    dist_feat = None
+    if hier is None:
+        g2h = rng.integers(0, n_devices, topo.node_count).astype(np.int32)
+        info = PartitionInfo(host=0, hosts=n_devices, global2host=g2h)
+        dist_feat = DistFeature.from_global_feature(feat, mesh, info)
     sampler = DistGraphSampler(topo, mesh, sizes=list(sizes))
 
     model = GraphSAGE(hidden=32, out_dim=classes, num_layers=len(sizes),
@@ -78,6 +108,7 @@ def run_dist_training(n_devices: int, n_nodes: int = 256,
     losses = []
     sampler_overflow = np.zeros(len(sizes), dtype=np.int64)
     feat_overflow = 0
+    dcn_crossings = 0
     masks = jnp.ones((n_devices, B), bool)
     for it in range(steps):
         seeds = rng.integers(0, n_nodes, (n_devices, B))
@@ -85,8 +116,17 @@ def run_dist_training(n_devices: int, n_nodes: int = 256,
         sampler_overflow += np.asarray(
             sampler.last_overflow
         ).sum(axis=0).astype(np.int64)
-        xs = dist_feat.lookup(np.asarray(n_id))
-        feat_overflow += int(np.asarray(dist_feat.last_overflow).sum())
+        if hier_feat is not None:
+            ids = hier_old2new[np.asarray(n_id)]  # hot-order ids
+            H, C = hier_feat.H, hier_feat.C
+            out = hier_feat.lookup(ids.reshape(H, C, -1))
+            st = hier_feat.traffic_stats()
+            dcn_crossings += int(st["dcn_crossings"].sum())
+            feat_overflow += int(st["drops"].sum())
+            xs = jnp.asarray(out).reshape(n_devices, -1, feat_dim)
+        else:
+            xs = dist_feat.lookup(np.asarray(n_id))
+            feat_overflow += int(np.asarray(dist_feat.last_overflow).sum())
         if state is None:
             params = model.init(
                 jax.random.PRNGKey(1), xs[0],
@@ -99,4 +139,4 @@ def run_dist_training(n_devices: int, n_nodes: int = 256,
         losses.append(float(loss))
     return dict(losses=losses, sampler_overflow=sampler_overflow,
                 feature_overflow=feat_overflow, mesh=mesh,
-                node_count=n_nodes)
+                node_count=n_nodes, dcn_crossings=dcn_crossings)

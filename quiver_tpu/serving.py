@@ -173,7 +173,8 @@ class InferenceServer:
                  device_batched_queue: "queue.Queue",
                  cpu_sampled_queue: Optional["queue.Queue"] = None,
                  result_queue: Optional["queue.Queue"] = None,
-                 max_coalesce: Optional[int] = None):
+                 max_coalesce: Optional[int] = None,
+                 fused: Optional[bool] = None):
         self.sampler = tpu_sampler
         self.feature = feature
         self.apply_fn = apply_fn
@@ -188,6 +189,17 @@ class InferenceServer:
             max_coalesce = cfg.max_coalesce
             self.BUCKETS = tuple(cfg.serving_buckets)
         self.max_coalesce = max_coalesce
+        # fused device lane: sample + gather + forward in ONE jit per
+        # bucket — no host hop between stages (the reference pays three
+        # kernel launches + a python step between each; TPU pays three
+        # dispatches AND a blocking n_id readback unless fused).  Needs
+        # the feature fully HBM-resident, like the fused train pipeline.
+        if fused is None:
+            fused = (getattr(feature, "node_count", 0) > 0
+                     and feature.cache_count >= feature.node_count
+                     and getattr(tpu_sampler, "mode", "TPU") == "TPU")
+        self._fused = fused
+        self._fused_fns = {}
         self._threads: List[threading.Thread] = []
         self._stopped = threading.Event()
 
@@ -213,11 +225,47 @@ class InferenceServer:
         for off in range(0, max(len(ids), 1), top):  # empty ids: one
             # zero-length chunk, padded to the smallest bucket
             chunk = ids[off: off + top]
-            batch = self.sampler.sample(self._pad_ids(chunk))
-            x = self.feature[np.asarray(batch.n_id)]
-            out = self.apply_fn(self.params, x, batch.layers)
+            padded = self._pad_ids(chunk)
+            if self._fused:
+                out = self._fused_forward(padded)
+            else:
+                batch = self.sampler.sample(padded)
+                x = self.feature[np.asarray(batch.n_id)]
+                out = self.apply_fn(self.params, x, batch.layers)
             outs.append(np.asarray(out)[: len(chunk)])
         return outs[0] if len(outs) == 1 else np.concatenate(outs)
+
+    def _fused_forward(self, padded_ids: np.ndarray):
+        """One jit per bucket size: sample -> gather -> model, no host
+        round-trips between the stages."""
+        import jax
+        import jax.numpy as jnp
+
+        from .sampler import run_pipeline
+        from .utils.rng import make_key
+
+        B = len(padded_ids)
+        fn = self._fused_fns.get(B)
+        if fn is None:
+            s = self.sampler
+            indptr, indices = s.csr_topo.to_device(s.device)
+            sizes = tuple(s.sizes)
+            caps = tuple(s.frontier_caps)
+            dedup, gm = s.dedup, s.gather_mode
+            cw = s._cum_weights  # weighted samplers stay weighted here
+            feature, apply_fn = self.feature, self.apply_fn
+
+            @jax.jit
+            def fn(params, seeds, key):
+                n_id, _, _, blocks, _ = run_pipeline(
+                    dedup, indptr, indices, seeds, key, sizes, caps,
+                    gather_mode=gm, cum_weights=cw)
+                x = feature.lookup_device(n_id)
+                return apply_fn(params, x, blocks)
+
+            self._fused_fns[B] = fn
+        return fn(self.params, jnp.asarray(padded_ids, jnp.int32),
+                  make_key(np.random.randint(0, 2**31 - 1)))
 
     def warmup(self, example_node: int = 0):
         """Compile every bucket's executable before traffic arrives.

@@ -34,3 +34,18 @@ def test_dist_training_quick_smoke():
     assert all(np.isfinite(l) for l in out["losses"])
     assert out["sampler_overflow"].sum() == 0
     assert out["feature_overflow"] == 0
+
+
+def test_dist_training_with_hier_feature():
+    """ICI x DCN HierFeature inside a real training loop: loss decreases
+    and hot-heavy frontiers keep most feature traffic off the DCN axis."""
+    out = run_dist_training(n_devices=8, n_nodes=3_000, avg_deg=10,
+                            feat_dim=8, batch_per_dev=8, sizes=[5, 4],
+                            steps=6, seed=3, hier=(2, 0.4))
+    losses = out["losses"]
+    assert all(np.isfinite(l) for l in losses)
+    assert np.mean(losses[-2:]) < np.mean(losses[:2])
+    assert out["feature_overflow"] == 0
+    total_queries = 8 * 8 * (1 + 5 + 5 * 4) * 6  # frontier size x steps
+    # degree-ordered hot tier: most queried rows resolve on ICI
+    assert out["dcn_crossings"] < 0.45 * total_queries

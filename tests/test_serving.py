@@ -234,7 +234,7 @@ def test_warmup_then_zero_recompiles(small_graph, rng):
     dq = queue.Queue()
     srv_sampler = GraphSageSampler(small_graph, [3])
     server = InferenceServer(srv_sampler, feature, apply_fn, params, dq,
-                             max_coalesce=1)
+                             max_coalesce=1, fused=False)
     server.BUCKETS = (4, 8, 16)
     sampler_builds = []
     orig_build = srv_sampler._build_jit
@@ -260,3 +260,37 @@ def test_warmup_then_zero_recompiles(small_graph, rng):
     # the storm hit only pre-warmed executables
     assert len(traces) == n_traces, f"recompiled: {traces[n_traces:]}"
     assert sorted(set(sampler_builds)) == [4, 8, 16]
+
+
+def test_fused_device_lane(small_graph, rng):
+    """Fully-cached feature auto-enables the fused one-jit lane; results
+    match the unfused path's shape/correctness and one executable exists
+    per bucket after warmup."""
+    n = small_graph.node_count
+    feat = rng.normal(size=(n, 4)).astype(np.float32)
+    feature = Feature(device_cache_size="1G").from_cpu_tensor(feat)
+    sampler = GraphSageSampler(small_graph, [3])
+    model = GraphSAGE(hidden=8, out_dim=2, num_layers=1, dropout=0.0)
+    b0 = sampler.sample(np.arange(8, dtype=np.int64))
+    params = model.init(jax.random.PRNGKey(0),
+                        feature[np.asarray(b0.n_id)], b0.layers)
+    apply_fn = lambda p, x, blocks: model.apply(p, x, blocks)
+    dq = queue.Queue()
+    server = InferenceServer(GraphSageSampler(small_graph, [3]), feature,
+                             apply_fn, params, dq, max_coalesce=1)
+    assert server._fused  # auto-on: feature fully HBM-resident
+    server.BUCKETS = (4, 8)
+    server.warmup()
+    assert sorted(server._fused_fns) == [4, 8]
+    server.start()
+    for i, sz in enumerate([2, 5, 7, 20]):
+        dq.put(ServingRequest(ids=rng.integers(0, n, sz), client=0, seq=i))
+    outs = {}
+    for _ in range(4):
+        req, out = server.result_queue.get(timeout=60)
+        assert not isinstance(out, Exception), out
+        outs[req.seq] = out
+    server.stop()
+    for i, sz in enumerate([2, 5, 7, 20]):
+        assert outs[i].shape == (sz, 2)
+    assert sorted(server._fused_fns) == [4, 8]  # storm added none
