@@ -18,6 +18,9 @@ int64_t qt_reindex(const int32_t*, const uint8_t*, int64_t, const int32_t*,
                    const uint8_t*, int32_t, int32_t*, uint8_t*, int32_t*);
 void qt_coo_to_csr(const int64_t*, const int64_t*, int64_t, int64_t,
                    int64_t*, int32_t*, int64_t*);
+void qt_sample_weighted(const int64_t*, const int32_t*, const float*,
+                        const int32_t*, const uint8_t*, int64_t, int32_t,
+                        uint64_t, int32_t, int32_t*, uint8_t*, int32_t*);
 void qt_neighbour_num(const int64_t*, const int32_t*, int64_t,
                       const int32_t*, int32_t, uint64_t, int32_t, int64_t*);
 }
@@ -82,6 +85,29 @@ int main() {
     for (int64_t b = 0; b < B; ++b) assert(n_id[b] == seeds[b]);
     for (int64_t i = 0; i < B * k; ++i)
         if (mask[i]) assert(n_id[local[i]] == nbrs[i]);
+
+    // --- weighted sampling: subset + counts, multithreaded
+    {
+        std::vector<float> cumw(E);
+        for (int64_t v = 0; v < N; ++v) {
+            float acc = 0.f;
+            for (int64_t p = indptr[v]; p < indptr[v + 1]; ++p)
+                cumw[p] = (acc += 1.0f + (float)(p % 3));
+        }
+        std::vector<int32_t> wn(N * k), wc(N);
+        std::vector<uint8_t> wm(N * k);
+        qt_sample_weighted(indptr.data(), indices.data(), cumw.data(),
+                           seeds.data(), nullptr, N, k, 77, 4, wn.data(),
+                           wm.data(), wc.data());
+        for (int64_t v = 0; v < N; ++v) {
+            int64_t deg = indptr[v + 1] - indptr[v];
+            assert(wc[v] == (deg < k ? deg : k));
+            std::multiset<int32_t> row(indices.begin() + indptr[v],
+                                       indices.begin() + indptr[v + 1]);
+            for (int32_t j = 0; j < wc[v]; ++j)
+                assert(row.count(wn[v * k + j]) > 0);
+        }
+    }
 
     // --- neighbour_num: zero-degree rows expand to zero
     std::vector<int64_t> nn(N);
