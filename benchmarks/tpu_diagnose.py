@@ -104,6 +104,14 @@ def main():
     stage("3-hop xla + rbg (small graph)", 300, lambda: hop3("xla"))
     stage("3-hop pallas + rbg (small graph)", 300, lambda: hop3("pallas"))
 
+    def hop3_hash():
+        s = GraphSageSampler(topo, [15, 10, 5], gather_mode="xla",
+                             sample_rng="hash")
+        s.sample(np.arange(1024, dtype=np.int32),
+                 key=key_r).n_id.block_until_ready()
+
+    stage("3-hop xla + HASH rng (small graph)", 300, hop3_hash)
+
     # ---- cold-tier placement experiment: can the TPU gather rows from a
     # host-memory-kind array under jit (the true zero-copy analogue)?
     def pinned_host_gather():

@@ -43,6 +43,35 @@ class SampleOut(NamedTuple):
     eid: Optional[jax.Array] = None  # [B, k] int32 global edge positions
 
 
+def _hash_uniform(key: jax.Array, shape) -> jax.Array:
+    """Counter-based uniforms from a few integer-hash rounds (finalizer of
+    splitmix/murmur lineage) — compiles to ~10 elementwise VPU ops, no
+    RNG algorithm HLO at all.
+
+    Escape hatch for backends where even the hardware-RNG lowering is
+    slow to compile (``sample_rng="hash"``); statistical quality is ample
+    for neighbor subsampling (the reference's curand Philox is likewise a
+    counter hash, just with more rounds — ``cuda_random.cu.hpp:12-20``).
+    """
+    data = jax.random.key_data(key).astype(jnp.uint32)
+    seed = data.reshape(-1)[-1] + data.reshape(-1)[0] * jnp.uint32(0x9E3779B9)
+    n = 1
+    for s in shape:
+        n *= s
+    x = jax.lax.iota(jnp.uint32, n).reshape(shape) + seed
+    for c1, c2 in ((0x85EBCA6B, 13), (0xC2B2AE35, 16), (0x27D4EB2F, 15)):
+        x = (x ^ (x >> c2)) * jnp.uint32(c1)
+    x = x ^ (x >> 16)
+    # 24-bit mantissa -> [0, 1)
+    return (x >> 8).astype(jnp.float32) * jnp.float32(1.0 / (1 << 24))
+
+
+def _uniform(key, shape, impl: str):
+    if impl == "hash":
+        return _hash_uniform(key, shape)
+    return jax.random.uniform(key, shape, dtype=jnp.float32)
+
+
 def _gather(table: jax.Array, idx: jax.Array, mode: str) -> jax.Array:
     """Element gather dispatch: 'xla' = jnp.take (clipped); 'lanes' = the
     row-gather + lane-select path (``ops.fastgather``) that sidesteps XLA's
@@ -67,7 +96,8 @@ def _gather(table: jax.Array, idx: jax.Array, mode: str) -> jax.Array:
     return jnp.take(table, idx, mode="clip")
 
 
-@functools.partial(jax.jit, static_argnames=("k", "gather_mode"))
+@functools.partial(jax.jit, static_argnames=("k", "gather_mode",
+                                             "sample_rng"))
 def sample_neighbors(
     indptr: jax.Array,
     indices: jax.Array,
@@ -76,6 +106,7 @@ def sample_neighbors(
     key: jax.Array,
     seed_mask: Optional[jax.Array] = None,
     gather_mode: str = "xla",
+    sample_rng: str = "auto",
 ) -> SampleOut:
     """Sample up to ``k`` distinct neighbors per seed from a CSR graph.
 
@@ -107,7 +138,7 @@ def sample_neighbors(
     # int64 multiply; deg < 2^24 holds for any real graph's max degree).
     lo = jnp.floor(j.astype(jnp.float32) * degf / k)
     hi = jnp.floor((j + 1).astype(jnp.float32) * degf / k)
-    u = jax.random.uniform(key, (B, k), dtype=jnp.float32)
+    u = _uniform(key, (B, k), sample_rng)
     strat = lo + jnp.floor(u * jnp.maximum(hi - lo, 1.0))
     pos = jnp.where(deg[:, None] <= k, j, strat.astype(jnp.int32))
     pos = jnp.minimum(pos.astype(jnp.int32), jnp.maximum(deg[:, None] - 1, 0))

@@ -93,7 +93,7 @@ class SampledBatch(NamedTuple):
 
 def _sample_pipeline_nodedup(indptr, indices, seeds, key, sizes,
                              gather_mode="xla", cum_weights=None,
-                             return_eid=False):
+                             return_eid=False, sample_rng="auto"):
     """Traced multi-hop pipeline WITHOUT dedup — the TPU hot path.
 
     Design note (why no hash table / no sort): the reference dedups every
@@ -121,7 +121,8 @@ def _sample_pipeline_nodedup(indptr, indices, seeds, key, sizes,
         else:
             out = sample_neighbors(indptr, indices, frontier, k, keys[l],
                                    seed_mask=fmask,
-                                   gather_mode=gather_mode)
+                                   gather_mode=gather_mode,
+                                   sample_rng=sample_rng)
         t = frontier.shape[0]
         pos = (t + jnp.arange(t, dtype=jnp.int32)[:, None] * k
                + jnp.arange(k, dtype=jnp.int32)[None, :])
@@ -147,7 +148,7 @@ def _sample_pipeline_nodedup(indptr, indices, seeds, key, sizes,
 
 def _sample_pipeline(indptr, indices, seeds, key, sizes, caps,
                      gather_mode="xla", cum_weights=None,
-                     return_eid=False):
+                     return_eid=False, sample_rng="auto"):
     """Traced multi-hop pipeline: outward sampling with per-hop dedup."""
     B = seeds.shape[0]
     frontier = seeds.astype(jnp.int32)
@@ -162,7 +163,8 @@ def _sample_pipeline(indptr, indices, seeds, key, sizes, caps,
                                             seed_mask=fmask)
         else:
             out = sample_neighbors(indptr, indices, frontier, k, keys[l],
-                                   seed_mask=fmask, gather_mode=gather_mode)
+                                   seed_mask=fmask, gather_mode=gather_mode,
+                                   sample_rng=sample_rng)
         r = reindex(frontier, out.nbrs, out.mask, seed_mask=fmask)
         blocks.append(
             LayerBlock(
@@ -193,17 +195,20 @@ def _sample_pipeline(indptr, indices, seeds, key, sizes, caps,
 
 
 def run_pipeline(dedup, indptr, indices, seeds, key, sizes, caps,
-                 gather_mode="xla", cum_weights=None, return_eid=False):
+                 gather_mode="xla", cum_weights=None, return_eid=False,
+                 sample_rng="auto"):
     """Dispatch to the dedup='none' or dedup='hop' traced pipeline — the
     single place that mapping lives (sampler jit + fused train/eval)."""
     if dedup == "none":
         return _sample_pipeline_nodedup(indptr, indices, seeds, key, sizes,
                                         gather_mode=gather_mode,
                                         cum_weights=cum_weights,
-                                        return_eid=return_eid)
+                                        return_eid=return_eid,
+                                        sample_rng=sample_rng)
     return _sample_pipeline(indptr, indices, seeds, key, sizes, caps,
                             gather_mode=gather_mode,
-                            cum_weights=cum_weights, return_eid=return_eid)
+                            cum_weights=cum_weights, return_eid=return_eid,
+                            sample_rng=sample_rng)
 
 
 class GraphSageSampler:
@@ -234,7 +239,8 @@ class GraphSageSampler:
                  frontier_caps: Optional[Sequence[Optional[int]]] = None,
                  dedup: str = "none", gather_mode: str = "auto",
                  edge_weights=None, return_eid: bool = False,
-                 uva_budget: Union[int, str, None] = None):
+                 uva_budget: Union[int, str, None] = None,
+                 sample_rng: str = "auto"):
         assert mode in ("TPU", "CPU", "UVA", "GPU"), mode
         if mode == "GPU":  # compat alias from the reference API
             mode = "TPU"
@@ -257,6 +263,8 @@ class GraphSageSampler:
                     else "xla"
                 )
         self.gather_mode = gather_mode
+        assert sample_rng in ("auto", "hash"), sample_rng
+        self.sample_rng = sample_rng
         self.return_eid = return_eid
         self.csr_topo = csr_topo
         self.sizes = list(sizes)
@@ -335,11 +343,13 @@ class GraphSageSampler:
 
         ret_eid = self.return_eid
 
+        srng = self.sample_rng
+
         @jax.jit
         def fn(seeds, key):
             return run_pipeline(dedup, indptr, indices, seeds, key, sizes,
                                 caps, gather_mode=gm, cum_weights=cw,
-                                return_eid=ret_eid)
+                                return_eid=ret_eid, sample_rng=srng)
 
         return fn
 

@@ -140,3 +140,36 @@ def test_sample_returns_valid_eids(small_graph):
                         < small_graph.indptr[b + 1])
             else:
                 assert eid[b, j] == -1
+
+
+def test_hash_rng_sampling(small_graph):
+    """sample_rng='hash' (counter-hash uniforms, compile-trivial): valid
+    edges, deterministic per key, different across keys, and the draws
+    spread over the neighbor set."""
+    from quiver_tpu import GraphSageSampler
+
+    s = GraphSageSampler(small_graph, [4, 3], sample_rng="hash")
+    k1, k2 = jax.random.PRNGKey(1), jax.random.PRNGKey(2)
+    b1 = s.sample(np.arange(16, dtype=np.int64), key=k1)
+    b1b = s.sample(np.arange(16, dtype=np.int64), key=k1)
+    b2 = s.sample(np.arange(16, dtype=np.int64), key=k2)
+    np.testing.assert_array_equal(np.asarray(b1.n_id), np.asarray(b1b.n_id))
+    assert not np.array_equal(np.asarray(b1.n_id), np.asarray(b2.n_id))
+    n_id = np.asarray(b1.n_id)
+    blk = b1.layers[-1]
+    local, m = np.asarray(blk.nbr_local), np.asarray(blk.mask)
+    for v in range(16):
+        row = set(small_graph.indices[
+            small_graph.indptr[v]: small_graph.indptr[v + 1]].tolist())
+        for j in range(4):
+            if m[v, j]:
+                assert n_id[local[v, j]] in row
+
+
+def test_hash_uniform_distribution():
+    from quiver_tpu.ops.sample import _hash_uniform
+
+    u = np.asarray(_hash_uniform(jax.random.PRNGKey(3), (200, 50)))
+    assert (u >= 0).all() and (u < 1).all()
+    assert 0.45 < u.mean() < 0.55
+    assert 0.07 < u.std() < 0.3
