@@ -52,13 +52,6 @@ def main():
     x0 = feature[np.asarray(b0.n_id)]
     params = model.init(jax.random.PRNGKey(0), x0, b0.layers)
     apply_fn = jax.jit(lambda p, x, blocks: model.apply(p, x, blocks))
-    # pre-warm every serving bucket so p99 excludes compile
-    for bucket in InferenceServer_Debug.BUCKETS:
-        if bucket > args.batch_max:
-            break
-        bb = tpu_sampler.sample(np.arange(bucket, dtype=np.int64))
-        apply_fn(params, feature[np.asarray(bb.n_id)], bb.layers)
-
     nn_num = generate_neighbour_num(topo, args.fanout, mode="expected")
     stream = queue.Queue()
     rb = RequestBatcher([stream], neighbour_num=nn_num,
@@ -69,7 +62,9 @@ def main():
     server = InferenceServer_Debug(
         tpu_sampler, feature, apply_fn, params,
         rb.device_batched_queue, hs.sampled_queue,
-    ).start()
+    )
+    server.warmup()  # every bucket compiled before traffic: p99 is real
+    server.start()
 
     # open-loop Poisson arrivals
     t_next = time.perf_counter()
