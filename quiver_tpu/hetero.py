@@ -138,8 +138,11 @@ class HeteroGraphSageSampler:
     """
 
     def __init__(self, topo: HeteroCSRTopo, sizes, num_hops: int = None,
-                 seed_type: str = "paper", device=None):
+                 seed_type: str = "paper", device=None,
+                 gather_mode: str = "xla", sample_rng: str = "auto"):
         self.topo = topo
+        self.gather_mode = gather_mode
+        self.sample_rng = sample_rng
         if isinstance(sizes, (list, tuple)):
             self.hop_sizes = [self._norm(s) for s in sizes]
         else:
@@ -180,7 +183,9 @@ class HeteroGraphSageSampler:
                 )
                 key, sub = jax.random.split(key)
                 out = sample_neighbors(indptr, indices, dst_ids, k, sub,
-                                       seed_mask=dst_mask)
+                                       seed_mask=dst_mask,
+                                       gather_mode=self.gather_mode,
+                                       sample_rng=self.sample_rng)
                 src_ids, src_mask = frontiers[s_t]
                 base = src_ids.shape[0]
                 t_len = dst_ids.shape[0]
