@@ -27,6 +27,10 @@ def main():
     ap.add_argument("--classes", type=int, default=32)
     ap.add_argument("--batch-size", type=int, default=64)
     ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--hier", type=int, default=0, metavar="N_HOSTS",
+                    help="use the two-tier ICI x DCN HierFeature over an "
+                         "[N_HOSTS, devices/N_HOSTS] mesh (degree-ordered "
+                         "hot tier covering 30%% of nodes)")
     args = ap.parse_args()
 
     import jax
@@ -58,9 +62,24 @@ def main():
 
     # graph row-sharded over the mesh; feature partitioned over the mesh
     sampler = DistGraphSampler(topo, mesh, sizes=[10, 5])
-    g2h = rng.integers(0, nd, topo.node_count).astype(np.int32)
-    info = PartitionInfo(host=0, hosts=nd, global2host=g2h)
-    dist_feat = DistFeature.from_global_feature(feat, mesh, info)
+    hier_feat = hier_old2new = None
+    if args.hier:
+        from jax.sharding import Mesh
+        from quiver_tpu import HierFeature
+
+        H = args.hier
+        hmesh = Mesh(np.array(jax.devices()[:nd]).reshape(H, nd // H),
+                     ("dcn", "ici"))
+        order = np.argsort(-topo.degree, kind="stable")
+        hier_old2new = np.empty(args.nodes, dtype=np.int32)
+        hier_old2new[order] = np.arange(args.nodes, dtype=np.int32)
+        hier_feat = HierFeature.from_global_feature(
+            feat[order], hmesh, hot_count=int(args.nodes * 0.3),
+            global2host=(np.arange(args.nodes) % H).astype(np.int32))
+    else:
+        g2h = rng.integers(0, nd, topo.node_count).astype(np.int32)
+        info = PartitionInfo(host=0, hosts=nd, global2host=g2h)
+        dist_feat = DistFeature.from_global_feature(feat, mesh, info)
 
     model = GraphSAGE(hidden=128, out_dim=args.classes, num_layers=2,
                       dropout=0.0)
@@ -70,7 +89,13 @@ def main():
     def sample_round(step):
         seeds = rng.integers(0, topo.node_count, (nd, B))
         n_id, n_mask, num, blocks = sampler.sample(seeds, key=step)
-        xs = dist_feat.lookup(np.asarray(n_id))
+        if hier_feat is not None:
+            ids = hier_old2new[np.asarray(n_id)]
+            out = hier_feat.lookup(
+                ids.reshape(hier_feat.H, hier_feat.C, -1))
+            xs = jnp.asarray(out).reshape(nd, -1, args.dim)
+        else:
+            xs = dist_feat.lookup(np.asarray(n_id))
         labs = jnp.asarray(labels[seeds])
         return n_id, blocks, xs, labs
 
@@ -98,6 +123,11 @@ def main():
     dt = time.perf_counter() - t0
     print(f"{args.steps} DP steps x {nd} replicas x {B} seeds "
           f"in {dt:.2f}s ({dt / args.steps * 1e3:.0f} ms/step)")
+    if hier_feat is not None:
+        st = hier_feat.traffic_stats()
+        print(f"hier: last-batch DCN crossings "
+              f"{int(st['dcn_crossings'].sum())}, drops "
+              f"{int(st['drops'].sum())}")
 
 
 if __name__ == "__main__":
