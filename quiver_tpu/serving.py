@@ -125,15 +125,36 @@ class HybridSampler:
     Pulls requests from the batcher's CPU queue, samples with the native
     host sampler, pushes ``(request, SampledBatch, sample_time)`` to
     ``sampled_queue``.
+
+    Requests are padded to the serving buckets BEFORE sampling: the
+    native sampler's output shapes are a fixed function of the seed
+    count, so bucketing here means the downstream device forward sees
+    only |buckets| distinct shapes (per-request shapes would compile a
+    fresh executable each — the CUDA reference has no such concern,
+    serving.py:132).  ``InferenceServer`` slices results back to the true
+    request length.
     """
 
     def __init__(self, cpu_sampler, cpu_batched_queue: "queue.Queue",
-                 num_workers: int = 2):
+                 num_workers: int = 2, buckets: Optional[Sequence] = None):
         self.sampler = cpu_sampler
         self.inq = cpu_batched_queue
         self.sampled_queue: "queue.Queue" = queue.Queue()
         self.num_workers = num_workers
+        if buckets is None:
+            from .config import get_config
+
+            buckets = tuple(get_config().serving_buckets)
+        self.buckets = tuple(buckets)
         self._threads: List[threading.Thread] = []
+
+    def _pad(self, ids: np.ndarray) -> np.ndarray:
+        b = _next_bucket(len(ids), self.buckets)
+        if len(ids) >= b:
+            return ids
+        return np.concatenate([ids, np.full(b - len(ids), ids[0] if
+                                            len(ids) else 0,
+                                            dtype=ids.dtype)])
 
     def _loop(self):
         while True:
@@ -142,7 +163,7 @@ class HybridSampler:
                 self.inq.put(_STOP)  # let siblings see it too
                 break
             t0 = time.perf_counter()
-            batch = self.sampler.sample(item.ids)
+            batch = self.sampler.sample(self._pad(np.asarray(item.ids)))
             self.sampled_queue.put((item, batch, time.perf_counter() - t0))
 
     def start(self):

@@ -294,3 +294,24 @@ def test_fused_device_lane(small_graph, rng):
     for i, sz in enumerate([2, 5, 7, 20]):
         assert outs[i].shape == (sz, 2)
     assert sorted(server._fused_fns) == [4, 8]  # storm added none
+
+
+def test_hybrid_sampler_buckets_cpu_lane(small_graph):
+    """CPU-lane batches arrive bucket-shaped: the device forward sees
+    only |buckets| distinct shapes regardless of request sizes."""
+    cpu_sampler = GraphSageSampler(small_graph, [3], mode="CPU")
+    inq = queue.Queue()
+    hs = HybridSampler(cpu_sampler, inq, num_workers=1,
+                       buckets=(4, 8)).start()
+    for i, sz in enumerate([1, 3, 5, 8, 11]):
+        inq.put(ServingRequest(ids=np.arange(sz), client=0, seq=i))
+    shapes = {}
+    for _ in range(5):
+        req, batch, dt = hs.sampled_queue.get(timeout=30)
+        shapes[req.seq] = batch.n_id.shape[0]
+    hs.stop()
+    # sizes 1,3 -> bucket 4; 5,8 -> bucket 8; 11 -> above top: as-is
+    frontier = lambda b: b + b * 3
+    assert shapes[0] == shapes[1] == frontier(4)
+    assert shapes[2] == shapes[3] == frontier(8)
+    assert shapes[4] == frontier(11)
