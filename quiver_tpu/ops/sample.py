@@ -155,7 +155,7 @@ def sample_neighbors(
     return SampleOut(nbrs=nbrs, mask=mask, counts=counts, eid=eid)
 
 
-@functools.partial(jax.jit, static_argnames=("k", "bits"))
+@functools.partial(jax.jit, static_argnames=("k", "bits", "sample_rng"))
 def sample_neighbors_weighted(
     indptr: jax.Array,
     indices: jax.Array,
@@ -165,6 +165,7 @@ def sample_neighbors_weighted(
     key: jax.Array,
     seed_mask: Optional[jax.Array] = None,
     bits: int = 24,
+    sample_rng: str = "auto",
 ) -> SampleOut:
     """Weight-proportional neighbor sampling (WITH replacement).
 
@@ -196,7 +197,7 @@ def sample_neighbors_weighted(
         jnp.take(cum_weights, jnp.maximum(end - 1, 0), mode="clip"),
         0.0,
     )
-    u = jax.random.uniform(key, (B, k)) * total[:, None]
+    u = _uniform(key, (B, k), sample_rng) * total[:, None]
 
     # binary search for first position p in [start, end) with cw[p] > u
     lo = jnp.broadcast_to(start[:, None], (B, k))

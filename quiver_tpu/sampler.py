@@ -117,7 +117,8 @@ def _sample_pipeline_nodedup(indptr, indices, seeds, key, sizes,
         if cum_weights is not None:
             out = sample_neighbors_weighted(indptr, indices, cum_weights,
                                             frontier, k, keys[l],
-                                            seed_mask=fmask)
+                                            seed_mask=fmask,
+                                            sample_rng=sample_rng)
         else:
             out = sample_neighbors(indptr, indices, frontier, k, keys[l],
                                    seed_mask=fmask,
@@ -160,7 +161,8 @@ def _sample_pipeline(indptr, indices, seeds, key, sizes, caps,
         if cum_weights is not None:
             out = sample_neighbors_weighted(indptr, indices, cum_weights,
                                             frontier, k, keys[l],
-                                            seed_mask=fmask)
+                                            seed_mask=fmask,
+                                            sample_rng=sample_rng)
         else:
             out = sample_neighbors(indptr, indices, frontier, k, keys[l],
                                    seed_mask=fmask, gather_mode=gather_mode,
