@@ -35,6 +35,7 @@ from .dist.init import initialize as distributed_initialize, make_hybrid_mesh
 from .dist.hier import HierFeature
 from .uva import UVAGraph
 from .utils.rng import make_key
+from .interop import to_torch_adjs, TorchSampleLoader
 from .partition import (
     partition_without_replication,
     quiver_partition_feature,
@@ -69,6 +70,7 @@ __all__ = [
     "DistFeature", "PartitionInfo", "TpuComm", "DistGraphSampler",
     "RingFeature", "distributed_initialize", "make_hybrid_mesh",
     "HierFeature", "UVAGraph", "make_key",
+    "to_torch_adjs", "TorchSampleLoader",
     "partition_without_replication", "quiver_partition_feature",
     "load_quiver_feature_partition",
     "generate_neighbour_num",

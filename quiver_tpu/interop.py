@@ -1,0 +1,80 @@
+"""PyTorch / PyG interop — consume quiver_tpu samples from torch code.
+
+The reference IS a PyG add-on: its sampler returns ``(n_id, batch_size,
+adjs)`` of torch tensors that drop into a PyG training loop
+(``sage_sampler.py:118-147``, README.md:186-212's "3-line swap").  A user
+migrating from it may keep a torch-side model while adopting this
+framework's samplers/feature store; these converters make that a 3-line
+swap in the other direction.
+
+Zero-copy where possible (numpy bridging; both sides share memory on
+CPU).  torch is an optional dependency — this module imports it lazily.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["to_torch_adjs", "to_torch", "TorchSampleLoader"]
+
+
+def to_torch(x):
+    """jax/numpy array -> torch tensor (shared memory on CPU)."""
+    import torch
+
+    return torch.from_numpy(np.ascontiguousarray(np.asarray(x)))
+
+
+def to_torch_adjs(batch):
+    """:class:`SampledBatch` -> PyG-style ``(n_id, batch_size, adjs)``
+    of torch tensors.
+
+    Each adj is ``(edge_index [2, e] long, e_id long, (n_src, n_dst))`` —
+    the exact contract of the reference sampler's return, so a PyG model
+    loop consumes it unchanged (see ``SampledBatch.to_pyg_adjs`` for the
+    padded-size semantics).
+    """
+    import torch
+
+    n_id, bs, adjs = batch.to_pyg_adjs()
+    out = []
+    for edge_index, e_id, size in adjs:
+        out.append((torch.from_numpy(edge_index.astype(np.int64)),
+                    torch.from_numpy(e_id.astype(np.int64)), size))
+    return torch.from_numpy(np.asarray(n_id).astype(np.int64)), bs, out
+
+
+class TorchSampleLoader:
+    """Iterate ``(n_id, batch_size, adjs, x, y)`` torch batches from a
+    quiver_tpu sampler + feature store — the reference's
+    ``for seeds in DataLoader: sample; feature[n_id]; model(...)`` loop
+    packaged for a torch training script.
+    """
+
+    def __init__(self, train_idx, sampler, feature, labels=None,
+                 batch_size: int = 1024, shuffle: bool = True, seed: int = 0):
+        self.train_idx = np.array(train_idx, copy=True)
+        self.sampler = sampler
+        self.feature = feature
+        self.labels = None if labels is None else np.asarray(labels)
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self._rng = np.random.default_rng(seed)
+
+    def __len__(self):
+        return (len(self.train_idx) + self.batch_size - 1) // self.batch_size
+
+    def __iter__(self):
+        import torch
+
+        if self.shuffle:
+            self._rng.shuffle(self.train_idx)
+        B = self.batch_size
+        for i in range(len(self)):
+            seeds = self.train_idx[i * B: (i + 1) * B]
+            batch = self.sampler.sample(seeds)
+            n_id, bs, adjs = to_torch_adjs(batch)
+            x = to_torch(self.feature[np.asarray(batch.n_id)])
+            y = (torch.from_numpy(self.labels[seeds]) if self.labels
+                 is not None else None)
+            yield n_id, bs, adjs, x, y
