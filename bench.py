@@ -267,7 +267,7 @@ def bench_feature(n_nodes, dim, batch_rows, iters=20):
 
 # ---------------------------------------------------------------- e2e epoch
 def bench_e2e(topo, dim, classes, batch_size, steps, dedup="none",
-              hidden=256, warmup=2):
+              hidden=256, warmup=2, dtype=None):
     """Fused-pipeline GraphSAGE epoch time at products scale.
 
     Baseline: 11.1 s / epoch (192 steps of B=1024, fanout [15,10,5],
@@ -290,7 +290,8 @@ def bench_e2e(topo, dim, classes, batch_size, steps, dedup="none",
     sampler = GraphSageSampler(topo, FANOUT, dedup=dedup)
     feature = Feature(device_cache_size=n,
                       cache_unit="rows").from_cpu_tensor(feat)
-    model = GraphSAGE(hidden=hidden, out_dim=classes, num_layers=3)
+    model = GraphSAGE(hidden=hidden, out_dim=classes, num_layers=3,
+                      dtype=dtype)
     tx = optax.adam(3e-3)
 
     b0 = sampler.sample(np.arange(batch_size, dtype=np.int32))
@@ -330,13 +331,15 @@ def bench_e2e(topo, dim, classes, batch_size, steps, dedup="none",
     per_step = dt / steps
     epoch_steps = PRODUCTS_TRAIN // batch_size
     epoch_s = per_step * epoch_steps
-    log(f"e2e dedup={dedup}: {steps} fused steps B={batch_size} in "
-        f"{dt:.3f}s ({per_step * 1e3:.1f} ms/step) -> "
+    dts = str(np.dtype(dtype)) if dtype else "f32"
+    log(f"e2e dedup={dedup} dtype={dts}: {steps} fused steps "
+        f"B={batch_size} in {dt:.3f}s ({per_step * 1e3:.1f} ms/step) -> "
         f"projected epoch ({epoch_steps} steps) {epoch_s:.2f}s, "
         f"final loss {float(loss):.3f}")
     return dict(epoch_s=round(epoch_s, 3),
                 ms_per_step=round(per_step * 1e3, 2),
                 steps_measured=steps, dedup=dedup,
+                dtype=str(np.dtype(dtype)) if dtype else "float32",
                 vs_baseline=round(BASELINE_EPOCH_S / epoch_s, 2))
 
 
@@ -501,6 +504,12 @@ def main():
             with _bounded("e2e-dedup-hop", 1200):
                 sections["e2e_dedup_hop"] = bench_e2e(
                     topo, feat_dim, classes, B, e2e_steps, dedup="hop")
+        with _bounded("e2e-bf16", 1200):
+            import jax.numpy as jnp
+
+            sections["e2e_bf16"] = bench_e2e(
+                topo, feat_dim, classes, B, e2e_steps,
+                dtype=jnp.bfloat16)
 
     if "serving" in want:
         with _bounded("serving", 900):
