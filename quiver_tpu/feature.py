@@ -388,8 +388,9 @@ class Feature:
         Applies ``feature_order`` on device; safe to call under jit."""
         import jax.numpy as jnp
 
-        assert self.cache_count >= self.node_count, (
-            "lookup_device needs a fully HBM-resident feature"
+        self.lazy_init_from_ipc_handle()
+        assert 0 < self.node_count <= self.cache_count, (
+            "lookup_device needs a (built) fully HBM-resident feature"
         )
         if self.feature_order is not None:
             if getattr(self, "_order_dev", None) is None:
