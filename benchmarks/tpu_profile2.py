@@ -1,0 +1,172 @@
+"""On-chip decomposition of the sampling hot path.
+
+Round-2 diagnosis measured 775 ms/batch steady for the 3-hop xla pipeline
+(B=1024, [15,10,5], 100K-node graph) — ~1.4M SEPS vs the 34.29M baseline.
+This script times each ingredient separately so the slow op is identified
+by measurement, not speculation:
+
+  * dispatch overhead (steady trivial jit over the axon tunnel)
+  * RNG steady throughput: threefry vs rbg vs counter-hash
+  * element gather: serialized `take` vs lanes row-gather+select, at
+    hop-1/2/3 index counts from small and products-sized tables
+  * feature-style row gather GB/s
+  * full 3-hop steady for each (gather_mode, rng) combo
+
+Each stage is SIGALRM-bounded so one pathological compile cannot eat the
+tunnel window.
+"""
+
+import os
+import signal
+import sys
+import time
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/root/repo/.jax_cache")
+sys.path.insert(0, "/root/repo")
+
+import numpy as np
+
+T0 = time.perf_counter()
+
+
+def log(m):
+    print(f"[{time.perf_counter() - T0:7.1f}s] {m}", flush=True)
+
+
+class Timeout(Exception):
+    pass
+
+
+signal.signal(signal.SIGALRM, lambda s, f: (_ for _ in ()).throw(Timeout()))
+
+
+def stage(name, seconds, fn):
+    log(f"--- {name} (limit {seconds}s)")
+    signal.alarm(seconds)
+    t0 = time.perf_counter()
+    try:
+        out = fn()
+        dt = time.perf_counter() - t0
+        log(f"ok {name}: {dt:.2f}s" + (f" -> {out}" if out else ""))
+        return out
+    except Timeout:
+        log(f"TIMEOUT {name}")
+    except Exception as e:
+        log(f"FAIL {name}: {type(e).__name__}: {str(e)[:300]}")
+    finally:
+        signal.alarm(0)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    stage("device init", 300, lambda: str(jax.devices()))
+
+    def timeit(fn, *argsets, iters=10):
+        """Compile, then steady-state ms/call (block only at the end).
+
+        ``argsets`` is a LIST of per-call argument tuples, cycled — the
+        remote-execution path replay-caches identical-args calls (see
+        docs/TPU_MEASUREMENTS.md "Methodology trap"), so every iteration
+        must present fresh input buffers.
+        """
+        if argsets and not isinstance(argsets[0], tuple):
+            argsets = [tuple(argsets)]  # legacy single-argset call
+        r = fn(*argsets[0])
+        jax.block_until_ready(r)
+        t0 = time.perf_counter()
+        for i in range(iters):
+            r = fn(*argsets[i % len(argsets)])
+        jax.block_until_ready(r)
+        return (time.perf_counter() - t0) / iters * 1e3
+
+    # --- 1. dispatch overhead (varied scalar per call)
+    f_triv = jax.jit(lambda x: x + 1)
+    xs = [(jnp.full(8, float(i)),) for i in range(30)]
+    stage("dispatch steady", 120,
+          lambda: f"{timeit(f_triv, *xs, iters=30):.2f} ms/call")
+
+    # --- 2. RNG steady (1M draws, the hop-3 shape); fresh key per call
+    for impl in ("threefry2x32", "rbg"):
+        keys = [(jax.random.key(i, impl=impl),) for i in range(10)]
+        f = jax.jit(lambda k: jax.random.uniform(k, (1 << 20,)))
+        stage(f"rng {impl} 1M uniform", 240,
+              lambda f=f, keys=keys: f"{timeit(f, *keys):.2f} ms")
+    from quiver_tpu.ops.sample import _uniform
+    hkeys = [(jax.random.key(i, impl="rbg"),) for i in range(10)]
+    f_hash = jax.jit(lambda k: _uniform(k, (1 << 20,), "hash"))
+    stage("rng hash 1M uniform", 240,
+          lambda: f"{timeit(f_hash, *hkeys):.2f} ms")
+
+    # --- 3. element gather modes
+    from quiver_tpu.ops.fastgather import element_gather, prepare_table
+
+    rng = np.random.default_rng(0)
+    for tab_n, tag in ((2_000_000, "2M"), (123_718_280, "124M")):
+        tab = jnp.asarray(rng.integers(0, 1 << 30, tab_n, dtype=np.int32))
+        tab2d = prepare_table(tab)
+        jax.block_until_ready(tab2d)
+        for m in (16_384, 163_840, 1_048_576):
+            idxs = [jnp.asarray(rng.integers(0, tab_n, m, dtype=np.int32))
+                    for _ in range(6)]
+            f_take = jax.jit(lambda t, i: jnp.take(t, i, mode="clip"))
+            f_lane = jax.jit(element_gather)
+            stage(f"take {tag} m={m}", 240,
+                  lambda: f"{timeit(*[f_take] + [(tab, i) for i in idxs], iters=6):.2f} ms")
+            stage(f"lanes {tag} m={m}", 240,
+                  lambda: f"{timeit(*[f_lane] + [(tab2d, i) for i in idxs], iters=6):.2f} ms")
+        del tab, tab2d
+
+    # --- 4. feature row gather GB/s (2.4M x 128 f32 ~ 1.25 GB)
+    feat = jnp.asarray(rng.normal(size=(2_400_000, 128)).astype(np.float32))
+    jax.block_until_ready(feat)
+    idsets = [(feat, jnp.asarray(
+        rng.integers(0, 2_400_000, 180_224, dtype=np.int32)))
+        for _ in range(6)]
+    f_row = jax.jit(lambda t, i: jnp.take(t, i, axis=0))
+
+    def rowg():
+        ms = timeit(f_row, *idsets, iters=6)
+        gbs = 180_224 * 128 * 4 / (ms / 1e3) / 1e9
+        return f"{ms:.2f} ms = {gbs:.1f} GB/s"
+
+    stage("feature row gather 180K x 128", 240, rowg)
+    del feat
+
+    # --- 5. full 3-hop steady per config
+    from quiver_tpu import CSRTopo, GraphSageSampler
+    from quiver_tpu.utils.synthetic import synthetic_csr
+
+    indptr, indices = synthetic_csr(100_000, 2_000_000, 0)
+    topo = CSRTopo(indptr=indptr, indices=indices)
+    seeds = np.arange(1024, dtype=np.int32)
+
+    for gm in ("xla", "lanes"):
+        for rng_name, impl in (("threefry", "threefry2x32"), ("rbg", "rbg"),
+                               ("hash", "rbg")):
+            key = jax.random.key(0, impl=impl)
+            # explicit "key" (NOT "auto" — auto resolves to hash on
+            # accelerators, which would make all three rows measure hash)
+            srng = "hash" if rng_name == "hash" else "key"
+
+            def run(gm=gm, key=key, srng=srng):
+                s = GraphSageSampler(topo, [15, 10, 5], gather_mode=gm,
+                                     sample_rng=srng)
+                out = s.sample(seeds, key=key)
+                jax.block_until_ready(out.n_id)
+                t0 = time.perf_counter()
+                for i in range(5):
+                    out = s.sample(seeds, key=jax.random.fold_in(key, i))
+                jax.block_until_ready(out.n_id)
+                ms = (time.perf_counter() - t0) / 5 * 1e3
+                seps = 1024 * (15 + 15 * 10 + 15 * 10 * 5) / (ms / 1e3)
+                return f"{ms:.1f} ms/batch = {seps / 1e6:.2f}M SEPS"
+
+            stage(f"3hop {gm}+{rng_name}", 300, run)
+
+    log("PROFILE2 DONE")
+
+
+if __name__ == "__main__":
+    main()

@@ -31,6 +31,9 @@ class Config:
     gather_mode: str = field(
         default_factory=lambda: _env("GATHER_MODE", "auto")
     )
+    sample_rng: str = field(
+        default_factory=lambda: _env("SAMPLE_RNG", "auto")
+    )
     dedup: str = field(default_factory=lambda: _env("DEDUP", "none"))
     # feature store
     cache_policy: str = field(
@@ -78,6 +81,57 @@ def _load_tuned(cfg: Config):
             and tuned.get("gather_mode") in ("xla", "lanes", "lanes_fused",
                                              "pallas")):
         cfg.gather_mode = tuned["gather_mode"]
+    if (cfg.sample_rng == "auto"
+            and tuned.get("sample_rng") in ("key", "hash")):
+        cfg.sample_rng = tuned["sample_rng"]
+
+
+def resolve_sample_rng(sample_rng: str) -> str:
+    """Map ``"auto"`` to the backend-measured best uniform source.
+
+    Resolution order: explicit kwarg > ``QUIVER_TPU_SAMPLE_RNG`` env /
+    tuned file > backend default.  Backend default (measured on a real
+    v5e, docs/TPU_MEASUREMENTS.md round 2): ``"hash"`` (counter-hash
+    uniforms) on accelerators — the 3-hop pipeline runs 50.8M SEPS with
+    hash vs 34.6M threefry / 31.3M rbg — and ``"key"`` (key-based
+    ``jax.random.uniform``) on CPU, where threefry is fast and tests want
+    reproducible streams.
+    """
+    if sample_rng not in ("auto", "key", "hash"):
+        raise ValueError(f"sample_rng must be auto|key|hash, got "
+                         f"{sample_rng!r}")
+    if sample_rng != "auto":
+        return sample_rng
+    cfg = get_config()
+    if cfg.sample_rng != "auto":
+        return resolve_sample_rng(cfg.sample_rng)  # validates env/tuned too
+    import jax
+
+    return "hash" if jax.default_backend() not in ("cpu",) else "key"
+
+
+def resolve_gather_mode(gather_mode: str) -> str:
+    """Map ``"auto"`` to the backend-measured best element-gather mode.
+
+    Resolution order: explicit kwarg > ``QUIVER_TPU_GATHER_MODE`` env /
+    tuned file > backend default.  Backend default: ``"lanes"``
+    (row-gather + VPU lane select) on accelerators, where XLA's 1-D
+    scalar gather serializes (docs/TPU_MEASUREMENTS.md round 2: 3-hop
+    lanes 27 ms vs xla 237 ms per batch on v5e); plain ``"xla"`` take on
+    CPU.
+    """
+    modes = ("auto", "xla", "lanes", "lanes_fused", "pallas")
+    if gather_mode not in modes:
+        raise ValueError(f"gather_mode must be one of {modes}, got "
+                         f"{gather_mode!r}")
+    if gather_mode != "auto":
+        return gather_mode
+    cfg = get_config()
+    if cfg.gather_mode != "auto":
+        return resolve_gather_mode(cfg.gather_mode)
+    import jax
+
+    return "lanes" if jax.default_backend() not in ("cpu",) else "xla"
 
 
 def get_config() -> Config:

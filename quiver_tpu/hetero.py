@@ -139,10 +139,12 @@ class HeteroGraphSageSampler:
 
     def __init__(self, topo: HeteroCSRTopo, sizes, num_hops: int = None,
                  seed_type: str = "paper", device=None,
-                 gather_mode: str = "xla", sample_rng: str = "auto"):
+                 gather_mode: str = "auto", sample_rng: str = "auto"):
         self.topo = topo
-        self.gather_mode = gather_mode
-        self.sample_rng = sample_rng
+        from .config import resolve_gather_mode, resolve_sample_rng
+
+        self.gather_mode = resolve_gather_mode(gather_mode)
+        self.sample_rng = resolve_sample_rng(sample_rng)
         if isinstance(sizes, (list, tuple)):
             self.hop_sizes = [self._norm(s) for s in sizes]
         else:

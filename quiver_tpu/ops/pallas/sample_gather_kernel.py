@@ -36,16 +36,18 @@ BLOCK = GPB * GROUP
 
 
 def _kernel(row_ref, lane_ref, table_ref, out_ref, rows_ref, sem):
-    # row_ref: [M] int32 scalar-prefetched covering-row ids (SMEM)
+    # row_ref: [1, GPB, GROUP] int32 covering-row ids — a per-program SMEM
+    #   block (NOT whole-array scalar prefetch: at hop-3 index counts the
+    #   full array is ~3.6 MB, 3.5x the 1 MB SMEM — measured OOM on v5e;
+    #   3-D because Mosaic requires the trailing block dims be (8k, 128k))
     # lane_ref/out_ref: [GPB, GROUP] int32 VMEM blocks
     # table_ref: [R, 128] in HBM (ANY)
     # rows_ref: [NBUF, GROUP, 128] scratch; sem: [NBUF, GROUP] DMA sems
-    base = pl.program_id(0) * BLOCK
 
     def copies(buf, g):
         return [
             pltpu.make_async_copy(
-                table_ref.at[row_ref[base + g * GROUP + e]],
+                table_ref.at[row_ref[0, g, e]],
                 rows_ref.at[buf, e],
                 sem.at[buf, e],
             )
@@ -83,27 +85,24 @@ def pallas_element_gather(table2d: jax.Array, idx: jax.Array,
         flat = jnp.concatenate(
             [flat, jnp.zeros((mp - m,), jnp.int32)]
         )
-    row = jax.lax.shift_right_logical(flat, 7)
+    row = jax.lax.shift_right_logical(flat, 7).reshape(-1, GPB, GROUP)
     lane = jnp.bitwise_and(flat, LANES - 1).reshape(-1, GROUP)
     out = pl.pallas_call(
         _kernel,
-        grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=1,
-            grid=(mp // BLOCK,),
-            in_specs=[
-                pl.BlockSpec((GPB, GROUP), lambda i, row_ref: (i, 0),
-                             memory_space=pltpu.VMEM),
-                pl.BlockSpec(memory_space=pltpu.ANY),
-            ],
-            out_specs=pl.BlockSpec(
-                (GPB, GROUP), lambda i, row_ref: (i, 0),
-                memory_space=pltpu.VMEM,
-            ),
-            scratch_shapes=[
-                pltpu.VMEM((NBUF, GROUP, LANES), table2d.dtype),
-                pltpu.SemaphoreType.DMA((NBUF, GROUP)),
-            ],
-        ),
+        grid=(mp // BLOCK,),
+        in_specs=[
+            pl.BlockSpec((1, GPB, GROUP), lambda i: (i, 0, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((GPB, GROUP), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=pl.BlockSpec((GPB, GROUP), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((NBUF, GROUP, LANES), table2d.dtype),
+            pltpu.SemaphoreType.DMA((NBUF, GROUP)),
+        ],
         out_shape=jax.ShapeDtypeStruct((mp // GROUP, GROUP),
                                        table2d.dtype),
         interpret=interpret,

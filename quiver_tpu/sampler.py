@@ -249,24 +249,10 @@ class GraphSageSampler:
         if mode == "UVA" and uva_budget is None:
             mode = "TPU"  # whole graph fits the (unbounded) budget
         assert dedup in ("none", "hop"), dedup
-        assert gather_mode in ("auto", "xla", "lanes", "lanes_fused",
-                               "pallas"), gather_mode
-        if gather_mode == "auto":
-            from .config import get_config
+        from .config import resolve_gather_mode, resolve_sample_rng
 
-            cfg_mode = get_config().gather_mode
-            if cfg_mode != "auto":
-                gather_mode = cfg_mode
-            else:
-                # the lane-select gather pays off where XLA serializes 1-D
-                # scalar gathers (TPU); plain take is better on CPU
-                gather_mode = (
-                    "lanes" if jax.default_backend() not in ("cpu",)
-                    else "xla"
-                )
-        self.gather_mode = gather_mode
-        assert sample_rng in ("auto", "hash"), sample_rng
-        self.sample_rng = sample_rng
+        self.gather_mode = resolve_gather_mode(gather_mode)
+        self.sample_rng = resolve_sample_rng(sample_rng)
         self.return_eid = return_eid
         self.csr_topo = csr_topo
         self.sizes = list(sizes)

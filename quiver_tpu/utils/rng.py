@@ -1,19 +1,18 @@
-"""PRNG key helper — TPU-friendly RNG implementation selection.
+"""PRNG key helper — TPU-measured RNG implementation selection.
 
-JAX's default threefry2x32 PRNG lowers to a large unrolled HLO per draw;
-on TPU that costs both compile time (measured: the dominant term in the
-sampler pipeline's first-call latency over the axon tunnel) and runtime
-(software hashing on the VPU).  The TPU hardware path is XLA's
-``RngBitGenerator`` (``impl="rbg"``), which compiles to a single op.
+Round-2 on-chip measurements (docs/TPU_MEASUREMENTS.md) overturned the
+round-1 hypothesis that threefry's unrolled HLO caused the sampler
+compile hang — that was a tunnel outage artifact.  On a real v5e the
+3-hop pipeline steady-state is threefry 237 ms/batch vs rbg 1866 ms/batch
+(uniform-heavy path, gather_mode="xla"): XLA's RngBitGenerator lowering
+is the SLOW one at sampling's draw volumes.  Default is therefore
+threefry2x32 everywhere — reproducible streams, fast steady-state; the
+hot sampler additionally bypasses per-draw key RNG entirely via
+``sample_rng="hash"`` (counter-hash uniforms, ``ops/sample.py``), so keys
+only feed cheap split/fold_in.
 
-The reference faces the same trade on GPU and picks the hardware-ish
-answer too: per-thread curand Philox states (``cuda_random.cu.hpp:12-20``),
-not a counter-based pure RNG.  ``make_key`` mirrors that: hardware RNG on
-accelerators, reproducible threefry on CPU (tests).
-
-Sampling uses RNG only to pick neighbor subsets — cryptographic stream
-quality is irrelevant; rbg's weaker cross-shard independence guarantees
-are fine.
+The reference's analogue is per-thread curand Philox
+(``cuda_random.cu.hpp:12-20``) — likewise a counter hash.
 """
 
 from __future__ import annotations
@@ -22,15 +21,10 @@ __all__ = ["make_key", "default_impl"]
 
 
 def default_impl() -> str:
-    """Backend-appropriate PRNG impl; ``QUIVER_TPU_PRNG`` overrides."""
+    """Default PRNG impl; ``QUIVER_TPU_PRNG`` overrides."""
     import os
 
-    import jax
-
-    env = os.environ.get("QUIVER_TPU_PRNG")
-    if env:
-        return env
-    return "rbg" if jax.default_backend() not in ("cpu",) else "threefry2x32"
+    return os.environ.get("QUIVER_TPU_PRNG") or "threefry2x32"
 
 
 def make_key(seed: int = 0, impl: str | None = None):
