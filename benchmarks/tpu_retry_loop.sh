@@ -1,24 +1,47 @@
 #!/bin/bash
-# Persistent TPU harvester: whenever the axon tunnel is up, run the
-# bounded diagnosis, then the full bench (results timestamped under
-# /tmp/tpu_runs).  Safe to leave running all session.
+# Persistent TPU harvester: whenever the axon tunnel is up, run the full
+# bench (results timestamped under /tmp/tpu_runs).  Retries every 2 min
+# while the tunnel is down; stops only after a bench run emits a valid
+# final JSON line (checked via json.loads on the last stdout line).
+# Safe to leave running all session.
 mkdir -p /tmp/tpu_runs
 n=0
+bench_tries=0
 while true; do
   n=$((n+1))
   ts=$(date +%H%M%S)
   # quick init probe with hard timeout: is the tunnel up at all?
   if timeout 240 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
-    echo "[$ts] tunnel UP - diagnose" >> /tmp/tpu_runs/loop.log
-    timeout 2400 python /root/repo/benchmarks/tpu_diagnose.py \
-      > /tmp/tpu_runs/diag_$ts.log 2>&1
-    echo "[$(date +%H%M%S)] diagnose rc=$? - bench" >> /tmp/tpu_runs/loop.log
-    timeout 3600 python /root/repo/bench.py --iters 20 --ab-dedup \
+    echo "[$ts] tunnel UP - bench" >> /tmp/tpu_runs/loop.log
+    timeout 4500 python /root/repo/bench.py --iters 20 --ab-dedup \
       > /tmp/tpu_runs/bench_$ts.json 2> /tmp/tpu_runs/bench_$ts.log
-    echo "[$(date +%H%M%S)] bench rc=$?" >> /tmp/tpu_runs/loop.log
-    # one full harvest is enough; park and let the operator decide more
-    echo "[$(date +%H%M%S)] harvest complete - sleeping 600" >> /tmp/tpu_runs/loop.log
-    sleep 600
+    rc=$?
+    echo "[$(date +%H%M%S)] bench rc=$rc" >> /tmp/tpu_runs/loop.log
+    if python - "$ts" << 'EOF'
+import json, sys
+ts = sys.argv[1]
+try:
+    lines = [l for l in open(f"/tmp/tpu_runs/bench_{ts}.json") if l.strip()]
+    out = json.loads(lines[-1])
+    ok = out.get("value", 0) > 0 and out.get("sections")
+except Exception:
+    ok = False
+sys.exit(0 if ok else 1)
+EOF
+    then
+      cp /tmp/tpu_runs/bench_$ts.json /tmp/tpu_runs/bench_FINAL.json
+      echo "[$(date +%H%M%S)] HARVEST COMPLETE -> bench_FINAL.json" >> /tmp/tpu_runs/loop.log
+      exit 0
+    fi
+    # invalid/partial result: back off before retrying (bench.py resumes
+    # finished sections from .bench_state.json, so retries are cheap),
+    # and give up after 8 bench attempts rather than spin all session
+    bench_tries=$((bench_tries+1))
+    if [ "$bench_tries" -ge 8 ]; then
+      echo "[$(date +%H%M%S)] giving up after $bench_tries bench attempts" >> /tmp/tpu_runs/loop.log
+      exit 1
+    fi
+    sleep 300
   else
     echo "[$ts] tunnel down (attempt $n)" >> /tmp/tpu_runs/loop.log
     sleep 120

@@ -77,18 +77,26 @@ class DistGraphSampler:
 
     def __init__(self, topo: CSRTopo, mesh: Mesh, sizes,
                  axis: str = "data", request_cap_frac: float = 1.0,
-                 seed: int = 0):
+                 seed: int = 0, gather_mode: str = "auto",
+                 sample_rng: str = "auto"):
+        from ..config import resolve_gather_mode, resolve_sample_rng
+
         self.topo = topo
         self.mesh = mesh
         self.axis = axis
+        self.gather_mode = resolve_gather_mode(gather_mode)
+        self.sample_rng = resolve_sample_rng(sample_rng)
         self.sizes = list(sizes)
         self.n = int(mesh.shape[axis])
         self.request_cap_frac = request_cap_frac
         row_starts, lips, lids = shard_csr_by_rows(topo, self.n)
         self.row_starts = jnp.asarray(row_starts, jnp.int32)
         # pad local shards to a common size, stack, shard over the mesh
-        max_ip = max(len(x) for x in lips)
-        max_id = max(len(x) for x in lids)
+        # (round up to 128 so the lanes gather's 128-lane reshape covers
+        # the whole table — its tail truncation must never drop real rows)
+        r128 = lambda v: -(-v // 128) * 128
+        max_ip = r128(max(len(x) for x in lips))
+        max_id = r128(max(len(x) for x in lids))
         pad = lambda a, m: np.pad(a, (0, m - len(a)))
         ip = np.stack([pad(x, max_ip) for x in lips]).astype(np.int32)
         ix = np.stack([pad(x, max_id) for x in lids]).astype(np.int32)
@@ -100,6 +108,7 @@ class DistGraphSampler:
     # ------------------------------------------------------------------
     def _hop(self, k: int, cap: int):
         n, axis = self.n, self.axis
+        gm, srng = self.gather_mode, self.sample_rng
         row_starts = self.row_starts
 
         def body(ip, ix, ids, valid, key):
@@ -129,7 +138,8 @@ class DistGraphSampler:
             local = jnp.clip(rids - row_starts[me], 0, ip.shape[0] - 2)
             sub = jax.random.fold_in(key, me)
             out = sample_neighbors(ip, ix, local, k, sub,
-                                   seed_mask=rvalid)
+                                   seed_mask=rvalid,
+                                   gather_mode=gm, sample_rng=srng)
             # ship [n, cap, k] neighbor ids (+1, 0=invalid) back
             payload = jnp.where(out.mask, out.nbrs + 1, 0).reshape(
                 n, cap, k

@@ -44,7 +44,7 @@ def make_fused_train_step(sampler: GraphSageSampler, feature: Feature,
     _check(feature)
     indptr, indices = sampler.csr_topo.to_device(sampler.device)
     sizes = tuple(sampler.sizes)
-    gm = sampler.gather_mode
+    gm, srng = sampler.gather_mode, sampler.sample_rng
     dedup = sampler.dedup
     caps = tuple(sampler.frontier_caps)
 
@@ -60,7 +60,8 @@ def make_fused_train_step(sampler: GraphSageSampler, feature: Feature,
     def step(state: TrainState, seeds, labels, label_mask, key):
         ks, kd = jax.random.split(key)
         n_id, n_mask, num, blocks, _ = run_pipeline(
-            dedup, indptr, indices, seeds, ks, sizes, caps, gather_mode=gm
+            dedup, indptr, indices, seeds, ks, sizes, caps, gather_mode=gm,
+            sample_rng=srng
         )
         x = feature.lookup_device(n_id)
 
@@ -117,7 +118,7 @@ def make_fused_eval_fn(sampler: GraphSageSampler, feature: Feature,
     _check(feature)
     indptr, indices = sampler.csr_topo.to_device(sampler.device)
     sizes = tuple(sampler.sizes)
-    gm = sampler.gather_mode
+    gm, srng = sampler.gather_mode, sampler.sample_rng
 
     dedup = sampler.dedup
     caps = tuple(sampler.frontier_caps)
@@ -125,7 +126,8 @@ def make_fused_eval_fn(sampler: GraphSageSampler, feature: Feature,
     @jax.jit
     def eval_fn(params, seeds, key):
         n_id, n_mask, num, blocks, _ = run_pipeline(
-            dedup, indptr, indices, seeds, key, sizes, caps, gather_mode=gm
+            dedup, indptr, indices, seeds, key, sizes, caps, gather_mode=gm,
+            sample_rng=srng
         )
         x = feature.lookup_device(n_id)
         return apply_fn(params, x, blocks, train=False, rngs=None)

@@ -405,7 +405,8 @@ class GraphSageSampler:
             key = make_key(np.random.randint(0, 2**31 - 1))
         gm = self.gather_mode
         n_id, n_mask, num, blocks = sample_uva(
-            self._uva, self.sizes, input_nodes, key, gather_mode=gm
+            self._uva, self.sizes, input_nodes, key, gather_mode=gm,
+            sample_rng=self.sample_rng
         )
         return SampledBatch(
             n_id=jnp.asarray(n_id), n_id_mask=jnp.asarray(n_mask),

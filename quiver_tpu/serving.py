@@ -273,6 +273,7 @@ class InferenceServer:
             sizes = tuple(s.sizes)
             caps = tuple(s.frontier_caps)
             dedup, gm = s.dedup, s.gather_mode
+            srng = s.sample_rng
             cw = s._cum_weights  # weighted samplers stay weighted here
             feature, apply_fn = self.feature, self.apply_fn
 
@@ -280,7 +281,7 @@ class InferenceServer:
             def fn(params, seeds, key):
                 n_id, _, _, blocks, _ = run_pipeline(
                     dedup, indptr, indices, seeds, key, sizes, caps,
-                    gather_mode=gm, cum_weights=cw)
+                    gather_mode=gm, cum_weights=cw, sample_rng=srng)
                 x = feature.lookup_device(n_id)
                 return apply_fn(params, x, blocks)
 

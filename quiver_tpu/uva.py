@@ -92,7 +92,8 @@ class UVAGraph:
                     hbm_bytes=int(self.hot_edges * 4))
 
 
-def sample_uva(uva: UVAGraph, sizes, input_nodes, key, gather_mode="xla"):
+def sample_uva(uva: UVAGraph, sizes, input_nodes, key, gather_mode="xla",
+               sample_rng="auto"):
     """Host-driven multi-hop loop over the hot/cold split.
 
     Per hop: device samples the hot rows (dispatched async), the native
@@ -116,7 +117,8 @@ def sample_uva(uva: UVAGraph, sizes, input_nodes, key, gather_mode="xla"):
         out = sample_neighbors(uva.indptr_dev, uva.indices_dev,
                                jnp.asarray(frontier), k, keys[l],
                                seed_mask=jnp.asarray(hot),
-                               gather_mode=gather_mode)
+                               gather_mode=gather_mode,
+                               sample_rng=sample_rng)
         # ... host tier runs while the device works; its RNG seed derives
         # from the same jax key, so a pinned key replays BOTH tiers
         cold_idx = np.nonzero(fmask & ~hot)[0]
