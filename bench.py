@@ -127,11 +127,16 @@ class _SectionRunner:
             with _bounded(name, seconds):
                 out = fn()
         finally:
+            # rollback lives in the finally so an external SIGTERM (e.g.
+            # the harvester's `timeout`) doesn't burn the attempt budget:
+            # main() converts SIGTERM to SystemExit, which passes through
+            # _bounded and lands here.  Only hard_kill's os._exit (and
+            # SIGKILL) keep the provisional increment.
             t.cancel()
-        self.state["attempts"][name] = attempts  # survived: roll back
-        if out is not None:
-            self.state["sections"][name] = out
-        self._save()
+            self.state["attempts"][name] = attempts
+            if out is not None:
+                self.state["sections"][name] = out
+            self._save()
         return out
 
 
@@ -162,10 +167,10 @@ class _bounded:
             log(f"SECTION TIMEOUT ({self.name} > {self.seconds}s) — "
                 "skipping")
             return True
-        if et is not None:
+        if et is not None and issubclass(et, Exception):
             log(f"section {self.name} failed: {et.__name__}: {ev}")
             return True
-        return False
+        return False  # KeyboardInterrupt/SystemExit propagate
 
 
 
@@ -187,6 +192,58 @@ def build_graph(n_nodes, n_edges, seed=0):
 
 
 # ---------------------------------------------------------------- sampling
+def probe_sampler_subprocess(gather_mode, sizes, probe_b, timeout,
+                             sample_rng="auto", nodes=200_000,
+                             edges=4_000_000):
+    """Compile + steady-time ONE sampler config in a killable subprocess;
+    returns ms/batch or raises (TimeoutExpired / RuntimeError).
+
+    Probes must not run in-process on a tunnel-attached TPU: a wedged
+    remote compile blocks the main thread inside a C call where signals
+    are never delivered — a subprocess can always be killed.  The child
+    builds a REDUCED synthetic graph (mode ranking is scale-independent;
+    re-uploading a full graph per probe costs more than the probe saves).
+    Shared by ``pick_gather_mode`` and ``benchmarks/autotune.py``.
+    """
+    import subprocess
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    src = f"""
+import os, sys, time
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      {os.path.join(here, ".jax_cache")!r})
+sys.path.insert(0, {here!r})
+import numpy as np
+import jax
+if os.environ.get("JAX_PLATFORMS"):
+    # the axon site hook re-exports JAX_PLATFORMS after env setup; the
+    # config API takes final precedence (same pin as bench.py main)
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+from quiver_tpu import CSRTopo, GraphSageSampler
+from quiver_tpu.utils.synthetic import synthetic_csr
+from quiver_tpu.utils.rng import make_key
+indptr, indices = synthetic_csr({nodes}, {edges}, 0)
+topo = CSRTopo(indptr=indptr, indices=indices)
+s = GraphSageSampler(topo, {list(sizes)!r}, gather_mode={gather_mode!r},
+                     sample_rng={sample_rng!r})
+seeds = np.random.default_rng(1).integers(
+    0, topo.node_count, {probe_b}).astype(np.int32)
+s.sample(seeds, key=make_key(0)).n_id.block_until_ready()
+t0 = time.perf_counter()
+for r in range(3):
+    s.sample(seeds, key=make_key(1 + r)).n_id.block_until_ready()
+print("PROBE_MS", (time.perf_counter() - t0) / 3 * 1e3)
+"""
+    p = subprocess.run([sys.executable, "-c", src], capture_output=True,
+                       text=True, timeout=timeout)
+    for line in p.stdout.splitlines():
+        if line.startswith("PROBE_MS"):
+            return float(line.split()[1])
+    err_lines = (p.stderr or "").strip().splitlines()
+    raise RuntimeError(err_lines[-1] if err_lines
+                       else f"rc={p.returncode}, no output")
+
+
 def pick_gather_mode(topo, batch_size, sizes, probe_timeout=420):
     """Pick the element-gather mode: tuned file if probed before on this
     backend, else probe each mode at a small batch and persist the winner.
@@ -214,46 +271,12 @@ def pick_gather_mode(topo, batch_size, sizes, probe_timeout=420):
         except Exception:
             pass
 
-    n = topo.node_count
     probe_b = min(256, batch_size)
     best_mode, best_dt = "xla", float("inf")
-    # NOTE: probes re-build the graph in a child process at REDUCED size
-    # (the mode ranking is scale-independent; re-uploading the full graph
-    # per mode would cost more than the probe saves)
-    probe_src = f"""
-import os, sys, time
-os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
-                      {os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache")!r})
-sys.path.insert(0, {os.path.dirname(os.path.abspath(__file__))!r})
-import numpy as np, jax
-from quiver_tpu import CSRTopo, GraphSageSampler
-from quiver_tpu.utils.synthetic import synthetic_csr
-from quiver_tpu.utils.rng import make_key
-indptr, indices = synthetic_csr(200_000, 4_000_000, 0)
-topo = CSRTopo(indptr=indptr, indices=indices)
-gm = sys.argv[1]
-s = GraphSageSampler(topo, {list(sizes)!r}, gather_mode=gm)
-seeds = np.random.default_rng(1).integers(
-    0, topo.node_count, {probe_b}).astype(np.int32)
-s.sample(seeds, key=make_key(0)).n_id.block_until_ready()
-t0 = time.perf_counter()
-for r in range(3):
-    s.sample(seeds, key=make_key(1 + r)).n_id.block_until_ready()
-print("PROBE_MS", (time.perf_counter() - t0) / 3 * 1e3)
-"""
     for gm in ("pallas", "lanes", "lanes_fused", "xla"):
         try:
-            p = subprocess.run([sys.executable, "-c", probe_src, gm],
-                               capture_output=True, text=True,
-                               timeout=probe_timeout)
-            ms = None
-            for line in p.stdout.splitlines():
-                if line.startswith("PROBE_MS"):
-                    ms = float(line.split()[1])
-            if ms is None:
-                err_lines = (p.stderr or "").strip().splitlines()
-                raise RuntimeError(err_lines[-1] if err_lines else
-                                   f"rc={p.returncode}, no output")
+            ms = probe_sampler_subprocess(gm, sizes, probe_b,
+                                          probe_timeout)
         except subprocess.TimeoutExpired:
             log(f"gather_mode={gm}: TIMEOUT after {probe_timeout}s (killed)")
             continue
@@ -282,6 +305,20 @@ print("PROBE_MS", (time.perf_counter() - t0) / 3 * 1e3)
     return best_mode
 
 
+def hop_caps(batch_size, sizes, frac=0.5):
+    """Frontier caps for ``dedup="hop"``: each hop's unique set on
+    power-law graphs sits well under the no-dedup bound (~35% at hop 3
+    on products-like degree distributions); capping at ``frac`` of the
+    bound keeps the XLA shapes small — WITHOUT caps the dedup pipeline
+    pays the sort at full no-dedup shapes and can never win the A/B."""
+    p = batch_size
+    caps = []
+    for k in sizes:
+        p = p * (1 + k)
+        caps.append(max(batch_size + 1, int(p * frac)))
+    return caps
+
+
 def bench_sampling(topo, batch_size, sizes, iters, gather_mode,
                    dedup="none", warmup=3, uva_budget=None,
                    sample_rng="auto"):
@@ -289,15 +326,7 @@ def bench_sampling(topo, batch_size, sizes, iters, gather_mode,
 
     from quiver_tpu import GraphSageSampler
 
-    caps = None
-    if dedup == "hop":
-        # cap each hop's frontier near the measured unique-set size on
-        # power-law graphs (~35% of the no-dedup bound at hop 3)
-        p = batch_size
-        caps = []
-        for k in sizes:
-            p = p * (1 + k)
-            caps.append(max(batch_size + 1, int(p * 0.5)))
+    caps = hop_caps(batch_size, sizes) if dedup == "hop" else None
     mode = "UVA" if uva_budget is not None else "TPU"
     sampler = GraphSageSampler(topo, sizes, gather_mode=gather_mode,
                                dedup=dedup, frontier_caps=caps,
@@ -338,7 +367,7 @@ def bench_sampling(topo, batch_size, sizes, iters, gather_mode,
         f"mean frontier {frontier:,.0f}")
     return dict(seps=round(seps, 1), ms_per_batch=round(dt / iters * 1e3, 3),
                 batch=batch_size, mean_frontier=round(frontier, 1),
-                dedup=dedup)
+                dedup=dedup, gather_mode=sampler.gather_mode)
 
 
 # ---------------------------------------------------------------- feature
@@ -427,7 +456,10 @@ def bench_e2e(topo, dim, classes, batch_size, steps, dedup="none",
     feat = rng.normal(size=(n, dim)).astype(np.float32)
     labels = rng.integers(0, classes, n).astype(np.int32)
 
-    sampler = GraphSageSampler(topo, FANOUT, dedup=dedup)
+    sampler = GraphSageSampler(
+        topo, FANOUT, dedup=dedup,
+        frontier_caps=hop_caps(batch_size, FANOUT) if dedup == "hop"
+        else None)
     feature = Feature(device_cache_size=n,
                       cache_unit="rows").from_cpu_tensor(feat)
     model = GraphSAGE(hidden=hidden, out_dim=classes, num_layers=3,
@@ -574,6 +606,12 @@ def main():
         feat_dim, feat_rows, classes = 100, 500_000, 47
         e2e_steps, n_requests = 30, 300
 
+    # SIGTERM (e.g. the harvester's `timeout`) -> SystemExit, so section
+    # attempt rollbacks in _SectionRunner.run's finally still execute
+    import signal as _signal
+
+    _signal.signal(_signal.SIGTERM, lambda *a: sys.exit(143))
+
     stage = {}
     _watchdog(600.0, stage)
     import jax
@@ -616,7 +654,18 @@ def main():
             gm = pick_gather_mode(topo, batches[0], FANOUT)
 
         # one section per batch size, so a stall at B=2048 cannot discard
-        # a finished B=1024 measurement
+        # a finished B=1024 measurement.  Cached sections measured under
+        # a DIFFERENT gather mode (probe outcome can vary across tunnel
+        # sessions) are invalidated, not reused-and-relabeled.
+        for name, sec in list(runner.state["sections"].items()):
+            # a missing gather_mode key (legacy state) counts as a
+            # mismatch too — never reuse-and-relabel across modes
+            if (name.startswith("sampling")
+                    and isinstance(sec, dict)
+                    and sec.get("gather_mode") != gm):
+                log(f"section {name}: cached under gather_mode="
+                    f"{sec.get('gather_mode')}, now {gm} — remeasuring")
+                del runner.state["sections"][name]
         results = []
         for b in batches:
             r = runner.run(

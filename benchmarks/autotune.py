@@ -1,24 +1,28 @@
-"""Hardware autotune: probe the gather-mode / batch-size space on the
+"""Hardware autotune: probe the gather-mode and sampling-RNG space on the
 current accelerator and persist the winners as library defaults.
 
 Run once per hardware generation:
 
-    python benchmarks/autotune.py [--nodes N --edges E]
+    python benchmarks/autotune.py [--fanout 15 10 5 --batch 512]
 
 Writes ``.quiver_tpu_tuned.json`` at the repo root;
 ``quiver_tpu.config.get_config()`` picks it up automatically, so samplers
-constructed with ``gather_mode="auto"`` use the measured winner.
+constructed with ``gather_mode="auto"`` / ``sample_rng="auto"`` use the
+measured winners.
+
+Every probe runs in a killable SUBPROCESS (``bench.probe_sampler_
+subprocess``): on a tunnel-attached TPU a wedged remote compile blocks
+the probing thread inside a C call where no signal is ever delivered —
+an in-process probe can hang this tool forever.
 """
 
 import argparse
 import json
 import os
+import subprocess
 import sys
-import time
 
-sys.path.insert(0, ".")
-
-import numpy as np
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 TUNED_PATH = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), ".quiver_tpu_tuned.json")
@@ -26,45 +30,54 @@ TUNED_PATH = os.path.join(os.path.dirname(os.path.dirname(
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--nodes", type=int, default=2_449_029)
-    ap.add_argument("--edges", type=int, default=123_718_280)
     ap.add_argument("--fanout", type=int, nargs="+", default=[15, 10, 5])
     ap.add_argument("--batch", type=int, default=512)
+    ap.add_argument("--timeout", type=int, default=420,
+                    help="hard per-probe subprocess timeout (s)")
     args = ap.parse_args()
 
     import jax
 
-    from bench import build_graph
-    from quiver_tpu import CSRTopo, GraphSageSampler
+    from bench import probe_sampler_subprocess
 
-    indptr, indices = build_graph(args.nodes, args.edges)
-    topo = CSRTopo(indptr=indptr, indices=indices)
-    rng = np.random.default_rng(0)
-    seeds = rng.integers(0, topo.node_count, args.batch).astype(np.int32)
-
-    results = {}
-    for gm in ("lanes", "lanes_fused", "xla"):
+    def probe(gm, srng="auto"):
+        tag = f"{gm}" + (f"+{srng}" if srng != "auto" else "")
         try:
-            s = GraphSageSampler(topo, args.fanout, gather_mode=gm)
-            s.sample(seeds).n_id.block_until_ready()
-            t0 = time.perf_counter()
-            for r in range(3):
-                s.sample(seeds,
-                         key=jax.random.PRNGKey(r)).n_id.block_until_ready()
-            results[gm] = (time.perf_counter() - t0) / 3
-            print(f"{gm}: {results[gm] * 1e3:.1f} ms/batch")
+            ms = probe_sampler_subprocess(gm, args.fanout, args.batch,
+                                          args.timeout, sample_rng=srng)
+        except subprocess.TimeoutExpired:
+            print(f"{tag}: TIMEOUT after {args.timeout}s (killed)")
+            return None
         except Exception as e:
-            print(f"{gm}: skipped ({type(e).__name__})")
+            print(f"{tag}: skipped ({e})")
+            return None
+        print(f"{tag}: {ms:.1f} ms/batch")
+        return ms
+
+    results = {gm: ms for gm in ("pallas", "lanes", "lanes_fused", "xla")
+               if (ms := probe(gm)) is not None}
     if not results:
         print("no mode succeeded; nothing written")
         return
     best = min(results, key=results.get)
+
+    # A/B the uniform source under the winning gather mode (key-based
+    # jax.random.uniform vs counter-hash — docs/TPU_MEASUREMENTS.md
+    # round 2 measured hash 1.5-2x faster on v5e; verify per hardware)
+    rng_results = {srng: ms for srng in ("key", "hash")
+                   if (ms := probe(best, srng)) is not None}
+
     payload = {
         "gather_mode": best,
         "backend": jax.default_backend(),
         "device": str(jax.devices()[0]),
-        "probe_ms": {k: round(v * 1e3, 2) for k, v in results.items()},
+        "probe_ms": {k: round(v, 2) for k, v in results.items()},
     }
+    if rng_results:
+        payload["sample_rng"] = min(rng_results, key=rng_results.get)
+        payload["rng_probe_ms"] = {
+            k: round(v, 2) for k, v in rng_results.items()
+        }
     with open(TUNED_PATH, "w") as f:
         json.dump(payload, f, indent=2)
     print(f"tuned defaults -> {TUNED_PATH}: {payload}")

@@ -76,7 +76,18 @@ class UVAGraph:
             indices_hot = np.concatenate(
                 [indices_hot, np.zeros(pad, np.int32)]
             )
-        self.indptr_dev = jnp.asarray(indptr_hot.astype(np.int32))
+        # indptr needs the same 128 padding: the lanes gather truncates
+        # the table to a 128 multiple and CLIPS indices — an unpadded
+        # [n+1] indptr silently returns a wrong row's pointers for the
+        # last (n+1) % 128 node ids
+        indptr_pad = indptr_hot.astype(np.int32)
+        ppad = (-len(indptr_pad)) % 128
+        if ppad:
+            # repeat the final offset: padded "rows" read as degree 0
+            indptr_pad = np.concatenate(
+                [indptr_pad, np.full(ppad, indptr_pad[-1], np.int32)]
+            )
+        self.indptr_dev = jnp.asarray(indptr_pad)
         self.indices_dev = jnp.asarray(indices_hot)
 
         from .cpp.native import CPUSampler

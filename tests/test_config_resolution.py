@@ -1,0 +1,86 @@
+"""Resolution precedence for gather_mode / sample_rng.
+
+Explicit kwarg > env (QUIVER_TPU_*) / tuned file > backend default.
+Backend default on CPU (the test backend): gather_mode="xla",
+sample_rng="key".  The accelerator branch ("lanes"/"hash",
+docs/TPU_MEASUREMENTS.md round 2) can't execute here; the precedence
+logic it shares is what's under test.
+"""
+
+import os
+
+import pytest
+
+import quiver_tpu.config as qconfig
+from quiver_tpu.config import resolve_gather_mode, resolve_sample_rng
+
+
+@pytest.fixture(autouse=True)
+def _clean_config():
+    """Reset the config singleton, scrub env overrides, and disable the
+    tuned-file loader around each test (a locally-written
+    .quiver_tpu_tuned.json must not leak into backend-default asserts)."""
+    saved = {k: os.environ.pop(k) for k in
+             ("QUIVER_TPU_GATHER_MODE", "QUIVER_TPU_SAMPLE_RNG")
+             if k in os.environ}
+    saved_loader = qconfig._load_tuned
+    qconfig._load_tuned = lambda cfg: None
+    qconfig._config = None
+    yield
+    os.environ.update(saved)
+    qconfig._load_tuned = saved_loader
+    qconfig._config = None
+
+
+def test_explicit_wins():
+    assert resolve_gather_mode("pallas") == "pallas"
+    assert resolve_sample_rng("hash") == "hash"
+
+
+def test_backend_default_cpu():
+    assert resolve_gather_mode("auto") == "xla"
+    assert resolve_sample_rng("auto") == "key"
+
+
+def test_env_overrides_auto():
+    os.environ["QUIVER_TPU_GATHER_MODE"] = "lanes"
+    os.environ["QUIVER_TPU_SAMPLE_RNG"] = "hash"
+    qconfig._config = None
+    assert resolve_gather_mode("auto") == "lanes"
+    assert resolve_sample_rng("auto") == "hash"
+
+
+def test_explicit_beats_env():
+    os.environ["QUIVER_TPU_GATHER_MODE"] = "lanes"
+    os.environ["QUIVER_TPU_SAMPLE_RNG"] = "hash"
+    qconfig._config = None
+    assert resolve_gather_mode("xla") == "xla"
+    assert resolve_sample_rng("key") == "key"
+
+
+def test_invalid_values_raise():
+    with pytest.raises(ValueError):
+        resolve_gather_mode("fast")
+    with pytest.raises(ValueError):
+        resolve_sample_rng("Hash")
+
+
+def test_invalid_env_raises_not_silently_defaults():
+    os.environ["QUIVER_TPU_SAMPLE_RNG"] = "keyed"
+    qconfig._config = None
+    with pytest.raises(ValueError):
+        resolve_sample_rng("auto")
+
+
+def test_sampler_resolves_at_init(small_graph_factory=None):
+    import numpy as np
+
+    from quiver_tpu import CSRTopo, GraphSageSampler
+    from quiver_tpu.utils.synthetic import synthetic_csr
+
+    indptr, indices = synthetic_csr(500, 4000, 0)
+    topo = CSRTopo(indptr=indptr, indices=indices)
+    s = GraphSageSampler(topo, [3], gather_mode="auto", sample_rng="auto")
+    assert s.gather_mode == "xla" and s.sample_rng == "key"
+    b = s.sample(np.arange(8, dtype=np.int32))
+    assert int(b.num_nodes) >= 8

@@ -80,3 +80,30 @@ def test_uva_pinned_key_replays_both_tiers(power_graph):
     for l1, l2 in zip(b1.layers, b2.layers):
         np.testing.assert_array_equal(np.asarray(l1.mask),
                                       np.asarray(l2.mask))
+
+
+def test_uva_lanes_gather_covers_tail_nodes():
+    """Regression: the lanes gather truncates tables to a 128 multiple
+    and clips indices — an unpadded [n+1] indptr returned a WRONG row's
+    pointers for the last (n+1) % 128 node ids.  Sample exactly those
+    tail nodes with gather_mode='lanes' on an all-hot UVA graph and
+    verify every edge against the CSR."""
+    rng = np.random.default_rng(7)
+    n = 300  # n+1 = 301: 45 tail ids past the 256 truncation boundary
+    deg = rng.integers(1, 6, n)
+    indptr = np.zeros(n + 1, np.int64)
+    np.cumsum(deg, out=indptr[1:])
+    indices = rng.integers(0, n, indptr[-1])
+    from quiver_tpu import CSRTopo
+
+    topo = CSRTopo(indptr=indptr, indices=indices)
+    s = GraphSageSampler(topo, [4], mode="UVA",
+                         uva_budget=topo.edge_count * 4,  # all hot
+                         gather_mode="lanes")
+    tail = np.arange(256, n, dtype=np.int64)  # ids the clip used to eat
+    b = s.sample(tail, key=jax.random.PRNGKey(2))
+    assert s._uva.stats()["cold_edges"] == 0
+    _check_valid(topo, b)
+    # also: counts must equal min(deg, k) — wrong pointers under-sample
+    counts = np.asarray(b.layers[-1].mask).sum(axis=1)
+    np.testing.assert_array_equal(counts, np.minimum(deg[tail], 4))
