@@ -11,6 +11,8 @@ segment-scatter in sight.
 
 from __future__ import annotations
 
+from typing import Optional
+
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
@@ -27,6 +29,13 @@ class SAGEConv(nn.Module):
     ``dtype=jnp.bfloat16`` runs the matmuls on the MXU's native format
     (params stay float32; activations/compute cast — the standard TPU
     mixed-precision recipe).
+
+    With ``edge_feat [T, k, De]`` (rows of an edge-feature table gathered
+    via ``LayerBlock.eid``; the caller masks nothing — invalid slots are
+    excluded here), aggregation becomes
+    ``W_self x + W_nbr concat(mean x_N(v), mean e)``: the masked mean of
+    a concat equals the concat of masked means, so the edge half is
+    reduced separately and never materializes a ``[T, k, D+De]`` tensor.
     """
 
     features: int
@@ -34,12 +43,16 @@ class SAGEConv(nn.Module):
     dtype: object = None
 
     @nn.compact
-    def __call__(self, x: jax.Array, block: LayerBlock) -> jax.Array:
+    def __call__(self, x: jax.Array, block: LayerBlock,
+                 edge_feat: Optional[jax.Array] = None) -> jax.Array:
         t = block.nbr_local.shape[0]
         x_src = jnp.take(x, block.nbr_local, axis=0)        # [T, k, D]
         m = block.mask[..., None].astype(x.dtype)
         cnt = jnp.maximum(m.sum(axis=1), 1.0)               # [T, 1]
         mean_nbr = (x_src * m).sum(axis=1) / cnt            # [T, D]
+        if edge_feat is not None:
+            mean_e = (edge_feat.astype(x.dtype) * m).sum(axis=1) / cnt
+            mean_nbr = jnp.concatenate([mean_nbr, mean_e], axis=-1)
         x_tgt = x[:t]
         out = nn.Dense(self.features, use_bias=self.use_bias,
                        dtype=self.dtype, name="lin_self")(x_tgt)

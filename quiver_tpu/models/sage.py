@@ -29,13 +29,30 @@ class GraphSAGE(nn.Module):
 
     @nn.compact
     def __call__(self, x: jax.Array, blocks: Tuple[LayerBlock, ...],
-                 train: bool = False) -> jax.Array:
+                 train: bool = False,
+                 edge_feat_table: jax.Array = None) -> jax.Array:
+        """``edge_feat_table [E, De]`` (optional) turns every layer into
+        an edge-featured aggregation: rows are gathered by the global
+        edge positions in ``LayerBlock.eid`` (sample with
+        ``return_eid=True``; -1 pad slots are clamped and masked out in
+        the conv).  The reference forwards ``Adj.e_id`` for user-side
+        lookup (``sage_sampler.py:143``); here the lookup runs under the
+        model's jit."""
         assert len(blocks) == self.num_layers, (
             f"{len(blocks)} blocks for {self.num_layers} layers"
         )
         for i, blk in enumerate(blocks):
+            efeat = None
+            if edge_feat_table is not None:
+                assert blk.eid is not None, (
+                    "edge_feat_table needs eid blocks — sample with "
+                    "return_eid=True"
+                )
+                efeat = jnp.take(edge_feat_table,
+                                 jnp.maximum(blk.eid, 0), axis=0)
             dim = self.out_dim if i == self.num_layers - 1 else self.hidden
-            x = SAGEConv(dim, dtype=self.dtype, name=f"conv{i}")(x, blk)
+            x = SAGEConv(dim, dtype=self.dtype,
+                         name=f"conv{i}")(x, blk, efeat)
             if i != self.num_layers - 1:
                 x = nn.relu(x)
                 x = nn.Dropout(self.dropout, deterministic=not train)(x)

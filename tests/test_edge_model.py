@@ -1,0 +1,85 @@
+"""Edge-featured model end to end: sampler eid -> edge-feature gather ->
+edge-featured GraphSAGE training.  Closes the reference's ``Adj.e_id`` loop
+(``sage_sampler.py:143`` forwards edge ids so user code can look up edge
+attributes); here the lookup runs under the model's jit.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from quiver_tpu import CSRTopo, GraphSageSampler
+from quiver_tpu.models import GraphSAGE
+
+
+@pytest.fixture
+def graph():
+    rng = np.random.default_rng(3)
+    n, e = 400, 3000
+    dst = np.sort(rng.integers(0, n, e))
+    src = rng.integers(0, n, e)
+    topo = CSRTopo(edge_index=np.stack([src, dst]))
+    return topo
+
+
+def test_edge_features_reach_model(graph):
+    E = graph.edge_count
+    efeat = np.random.default_rng(0).normal(size=(E, 4)).astype(np.float32)
+    x = np.random.default_rng(1).normal(size=(graph.node_count, 8)
+                                        ).astype(np.float32)
+    s = GraphSageSampler(graph, [5, 3], return_eid=True)
+    b = s.sample(np.arange(16, dtype=np.int32), key=jax.random.PRNGKey(0))
+    assert all(blk.eid is not None for blk in b.layers)
+
+    model = GraphSAGE(hidden=16, out_dim=3, num_layers=2, dropout=0.0)
+    xb = jnp.asarray(x)[b.n_id]
+    params = model.init(jax.random.PRNGKey(1), xb, b.layers,
+                        edge_feat_table=jnp.asarray(efeat))
+    out = model.apply(params, xb, b.layers,
+                      edge_feat_table=jnp.asarray(efeat))
+    assert out.shape == (16, 3)
+    assert np.isfinite(np.asarray(out)).all()
+
+    # edge features actually flow: zeroing the table changes the output
+    out0 = model.apply(params, xb, b.layers,
+                       edge_feat_table=jnp.zeros_like(efeat))
+    assert not np.allclose(np.asarray(out), np.asarray(out0))
+
+
+def test_edge_model_trains(graph):
+    E = graph.edge_count
+    rng = np.random.default_rng(7)
+    efeat = jnp.asarray(rng.normal(size=(E, 4)).astype(np.float32))
+    x = jnp.asarray(rng.normal(size=(graph.node_count, 8)
+                               ).astype(np.float32))
+    labels = jnp.asarray(rng.integers(0, 3, 16))
+
+    s = GraphSageSampler(graph, [5, 3], return_eid=True)
+    model = GraphSAGE(hidden=16, out_dim=3, num_layers=2, dropout=0.0)
+    b0 = s.sample(np.arange(16, dtype=np.int32), key=jax.random.PRNGKey(0))
+    params = model.init(jax.random.PRNGKey(1), x[b0.n_id], b0.layers,
+                        edge_feat_table=efeat)
+    tx = optax.adam(1e-2)
+    opt = tx.init(params)
+
+    @jax.jit
+    def step(params, opt, n_id, blocks):
+        def loss_fn(p):
+            logits = model.apply(p, x[n_id], blocks,
+                                 edge_feat_table=efeat)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, labels).mean()
+
+        loss, g = jax.value_and_grad(loss_fn)(params)
+        up, opt = tx.update(g, opt)
+        return optax.apply_updates(params, up), opt, loss
+
+    losses = []
+    for i in range(12):
+        b = s.sample(np.arange(16, dtype=np.int32),
+                     key=jax.random.PRNGKey(10 + i))
+        params, opt, loss = step(params, opt, b.n_id, b.layers)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
