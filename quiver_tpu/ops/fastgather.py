@@ -19,7 +19,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-__all__ = ["element_gather", "prepare_table"]
+__all__ = ["element_gather", "prepare_table", "pad_table_128"]
 
 LANES = 128
 
@@ -37,6 +37,24 @@ def prepare_table(table: jax.Array) -> jax.Array:
             [table, jnp.zeros((pad,), table.dtype)]
         )
     return table.reshape(-1, LANES)
+
+
+def pad_table_128(table, fill=None):
+    """Pad a 1-D table to a multiple of 128 (host numpy or jnp).
+
+    ``fill=None`` zero-pads; otherwise pads with ``fill`` (e.g. the last
+    cumulative weight so clipped probes read a harmless value).  The
+    lanes/pallas gather modes REQUIRE 128-multiple tables — ``_gather``
+    rejects anything else rather than silently truncating.
+    """
+    n = table.shape[0]
+    pad = (-n) % 128
+    if not pad:
+        return table
+    val = fill if fill is not None else 0
+    return jnp.concatenate(
+        [table, jnp.full((pad,), val, table.dtype)]
+    )
 
 
 def element_gather(table2d: jax.Array, idx: jax.Array,

@@ -80,7 +80,12 @@ def _gather(table: jax.Array, idx: jax.Array, mode: str) -> jax.Array:
     if mode in ("lanes", "lanes_fused"):
         from .fastgather import element_gather
 
-        m = table.shape[0] // 128 * 128
+        assert table.shape[0] % 128 == 0, (
+            f"lanes gather needs a 128-multiple table, got "
+            f"{table.shape[0]} — pad with ops.fastgather.pad_table_128 "
+            f"(CSRTopo.to_device / the samplers do this for you)"
+        )
+        m = table.shape[0]
         return element_gather(
             table[:m].reshape(-1, 128),
             jnp.clip(idx, 0, m - 1),
@@ -89,7 +94,11 @@ def _gather(table: jax.Array, idx: jax.Array, mode: str) -> jax.Array:
     if mode == "pallas":
         from .pallas.sample_gather_kernel import pallas_element_gather
 
-        m = table.shape[0] // 128 * 128
+        assert table.shape[0] % 128 == 0, (
+            f"pallas gather needs a 128-multiple table, got "
+            f"{table.shape[0]} — pad with ops.fastgather.pad_table_128"
+        )
+        m = table.shape[0]
         return pallas_element_gather(
             table[:m].reshape(-1, 128), jnp.clip(idx, 0, m - 1)
         )
@@ -155,7 +164,8 @@ def sample_neighbors(
     return SampleOut(nbrs=nbrs, mask=mask, counts=counts, eid=eid)
 
 
-@functools.partial(jax.jit, static_argnames=("k", "bits", "sample_rng"))
+@functools.partial(jax.jit, static_argnames=("k", "bits", "sample_rng",
+                                              "gather_mode"))
 def sample_neighbors_weighted(
     indptr: jax.Array,
     indices: jax.Array,
@@ -166,6 +176,7 @@ def sample_neighbors_weighted(
     seed_mask: Optional[jax.Array] = None,
     bits: int = 24,
     sample_rng: str = "auto",
+    gather_mode: str = "xla",
 ) -> SampleOut:
     """Weight-proportional neighbor sampling (WITH replacement).
 
@@ -182,8 +193,8 @@ def sample_neighbors_weighted(
     """
     seeds = seeds.astype(jnp.int32)
     B = seeds.shape[0]
-    start = jnp.take(indptr, seeds, mode="clip")
-    end = jnp.take(indptr, seeds + 1, mode="clip")
+    start = _gather(indptr, seeds, gather_mode)
+    end = _gather(indptr, seeds + 1, gather_mode)
     deg = end - start
     if seed_mask is not None:
         deg = jnp.where(seed_mask, deg, 0)
@@ -194,7 +205,7 @@ def sample_neighbors_weighted(
     # total row weight = cum_weights[end-1] (inclusive cumsum per row)
     total = jnp.where(
         deg > 0,
-        jnp.take(cum_weights, jnp.maximum(end - 1, 0), mode="clip"),
+        _gather(cum_weights, jnp.maximum(end - 1, 0), gather_mode),
         0.0,
     )
     u = _uniform(key, (B, k), sample_rng) * total[:, None]
@@ -204,9 +215,12 @@ def sample_neighbors_weighted(
     hi = jnp.broadcast_to(end[:, None], (B, k))
 
     def step(_, lohi):
+        # the gather here runs ``bits`` times — with gather_mode="lanes"
+        # each round is a near-bandwidth row gather instead of XLA's
+        # serialized 1-D scalar gather (the dominant cost on TPU)
         lo, hi = lohi
         mid = (lo + hi) // 2
-        cw = jnp.take(cum_weights, mid, mode="clip")
+        cw = _gather(cum_weights, mid, gather_mode)
         gt = cw > u
         return jnp.where(gt, lo, mid + 1), jnp.where(gt, mid, hi)
 
@@ -214,7 +228,7 @@ def sample_neighbors_weighted(
     pos = jnp.clip(lo, start[:, None], jnp.maximum(end[:, None] - 1, 0))
     # deg <= k: take all neighbors once instead of resampling
     pos = jnp.where(deg[:, None] <= k, start[:, None] + j, pos)
-    nbrs = jnp.take(indices, jnp.where(mask, pos, 0), mode="clip")
+    nbrs = _gather(indices, jnp.where(mask, pos, 0), gather_mode)
     nbrs = jnp.where(mask, nbrs, jnp.int32(-1))
     eid = jnp.where(mask, pos, jnp.int32(-1))
     return SampleOut(nbrs=nbrs, mask=mask, counts=counts, eid=eid)

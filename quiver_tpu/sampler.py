@@ -118,7 +118,8 @@ def _sample_pipeline_nodedup(indptr, indices, seeds, key, sizes,
             out = sample_neighbors_weighted(indptr, indices, cum_weights,
                                             frontier, k, keys[l],
                                             seed_mask=fmask,
-                                            sample_rng=sample_rng)
+                                            sample_rng=sample_rng,
+                                            gather_mode=gather_mode)
         else:
             out = sample_neighbors(indptr, indices, frontier, k, keys[l],
                                    seed_mask=fmask,
@@ -162,7 +163,8 @@ def _sample_pipeline(indptr, indices, seeds, key, sizes, caps,
             out = sample_neighbors_weighted(indptr, indices, cum_weights,
                                             frontier, k, keys[l],
                                             seed_mask=fmask,
-                                            sample_rng=sample_rng)
+                                            sample_rng=sample_rng,
+                                            gather_mode=gather_mode)
         else:
             out = sample_neighbors(indptr, indices, frontier, k, keys[l],
                                    seed_mask=fmask, gather_mode=gather_mode,
@@ -282,7 +284,12 @@ class GraphSageSampler:
             cw = row_cumsum_weights(csr_topo.indptr, edge_weights)
             import jax.numpy as _jnp
 
-            self._cum_weights = _jnp.asarray(cw)
+            from .ops.fastgather import pad_table_128
+
+            # edge-value fill: clipped probes past E read a harmless
+            # value; the lanes/pallas gathers require 128-multiple tables
+            self._cum_weights = pad_table_128(
+                _jnp.asarray(cw), fill=float(cw[-1]) if len(cw) else None)
         if mode == "TPU":
             csr_topo.to_device(device)
 

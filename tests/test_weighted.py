@@ -127,3 +127,26 @@ def test_cpu_mode_sampler_weighted_end_to_end(small_graph, rng):
         for j in range(4):
             if m[v, j]:
                 assert n_id[local[v, j]] in row
+
+
+def test_weighted_lanes_matches_xla(wgraph):
+    """gather_mode='lanes' draws identical samples to 'xla' for the same
+    key (the binary search reads the same cum_weights values either
+    way).  Tables shorter than 128 exercise the truncation path only via
+    the padded-table contract, so pad like the sampler does."""
+    from quiver_tpu.ops.fastgather import pad_table_128
+
+    indptr, indices, cw, _ = wgraph
+    ip = pad_table_128(indptr, fill=int(indptr[-1]))
+    ix = pad_table_128(indices)
+    cwp = pad_table_128(cw, fill=float(cw[-1]))
+    seeds = jnp.asarray([0, 1, 2], dtype=jnp.int32)
+    for i in range(5):
+        key = jax.random.PRNGKey(i)
+        a = sample_neighbors_weighted(ip, ix, cwp, seeds, 3, key,
+                                      gather_mode="xla")
+        b = sample_neighbors_weighted(ip, ix, cwp, seeds, 3, key,
+                                      gather_mode="lanes")
+        np.testing.assert_array_equal(np.asarray(a.nbrs), np.asarray(b.nbrs))
+        np.testing.assert_array_equal(np.asarray(a.mask), np.asarray(b.mask))
+        np.testing.assert_array_equal(np.asarray(a.eid), np.asarray(b.eid))
