@@ -32,10 +32,13 @@ os.environ.setdefault(
 BASELINE_SEPS = 34.29e6      # docs/Introduction_en.md:41
 BASELINE_FEATURE_GBS = 14.82  # docs/Introduction_en.md:95
 BASELINE_EPOCH_S = 11.1       # docs/Introduction_en.md:146 (1-GPU quiver)
+BASELINE_REDDIT_SEPS = 33.15e6  # docs/Introduction_en.md:43 ([25,10] UVA)
 
 PRODUCTS_NODES, PRODUCTS_EDGES = 2_449_029, 123_718_280
 PRODUCTS_TRAIN = 196_615      # ogbn-products train split size
 FANOUT = [15, 10, 5]
+REDDIT_NODES, REDDIT_EDGES = 232_965, 114_615_892
+REDDIT_FANOUT = [25, 10]
 
 
 def _watchdog(seconds: float, stage: dict):
@@ -693,6 +696,21 @@ def main():
             return r
 
         runner.run("sampling_uva", 900, _uva)
+
+        def _reddit():
+            # the baseline's second sampling headline: Reddit scale,
+            # fanout [25,10], vs 33.15M SEPS (Introduction_en.md:43)
+            rn = (REDDIT_NODES, REDDIT_EDGES) if not args.small else (
+                50_000, 2_000_000)
+            rip, rix = build_graph(*rn, seed=7)
+            rtopo = CSRTopo(indptr=rip, indices=rix)
+            rtopo.to_device()
+            r = bench_sampling(rtopo, bb, REDDIT_FANOUT, args.iters, gm)
+            r["fanout"] = REDDIT_FANOUT
+            r["vs_baseline"] = round(r["seps"] / BASELINE_REDDIT_SEPS, 3)
+            return r
+
+        runner.run("sampling_reddit", 900, _reddit)
 
     if "feature" in want:
         runner.run("feature", 600,
