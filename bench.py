@@ -42,13 +42,19 @@ REDDIT_FANOUT = [25, 10]
 
 
 def _watchdog(seconds: float, stage: dict):
-    """Abort instead of hanging forever if the device tunnel is dead."""
+    """Emit best-evidence JSON instead of hanging forever (or exiting
+    empty) if the device tunnel is dead.  Two rounds of BENCH_r0N.json
+    were lost to `os._exit(3)` discarding cached sections — the driver's
+    artifact must parse even when the tunnel never comes up."""
 
     def check():
         if not stage.get("device_ready"):
-            print(f"bench watchdog: no TPU after {seconds:.0f}s "
-                  f"(tunnel down?) — aborting", file=sys.stderr, flush=True)
-            os._exit(3)
+            log(f"bench watchdog: no TPU after {seconds:.0f}s (tunnel "
+                f"down?) — emitting cached/committed evidence instead")
+            _emit_result(_fallback_sections(), device_live=False,
+                         note=f"no TPU after {seconds:.0f}s; sections are "
+                              "prior on-chip measurements, not this run")
+            os._exit(0)
 
     t = threading.Timer(seconds, check)
     t.daemon = True
@@ -62,6 +68,73 @@ class _SectionTimeout(Exception):
 
 STATE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                           ".bench_state.json")
+MEASURED_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "docs", "tpu_measured.json")
+
+
+def _load_all_states():
+    """All fingerprints' resume states.  v2 format keeps one entry per
+    fingerprint so a CPU smoke run can never clobber TPU sections (round
+    2 lost its TPU partial state exactly that way)."""
+    try:
+        raw = json.load(open(STATE_PATH))
+        if not isinstance(raw, dict):
+            return {}
+        if isinstance(raw.get("states"), dict):
+            return raw["states"]
+        if raw.get("fp"):  # legacy single-fp layout
+            return {raw["fp"]: {"sections": raw.get("sections", {}),
+                                "attempts": raw.get("attempts", {})}}
+    except Exception:
+        pass
+    return {}
+
+
+def _fallback_sections():
+    """Best-evidence sections when the chip is unreachable: committed
+    on-chip measurements (docs/tpu_measured.json) overlaid by anything a
+    previous TPU-fingerprint run cached in .bench_state.json.  Every
+    entry is labeled with its source — nothing masquerades as fresh."""
+    sections = {}
+    try:
+        m = json.load(open(MEASURED_PATH))
+        for k, v in (m.get("sections") or {}).items():
+            if isinstance(v, dict):
+                sections[k] = dict(v, source="committed_measurement")
+    except Exception:
+        pass
+    for fp, st in sorted(_load_all_states().items()):
+        # only probed-mode TPU runs: forced --gather-mode fingerprints
+        # ("|gm=") are A/B artifacts, not interchangeable headline numbers
+        if not fp.startswith("tpu") or "|gm=" in fp:
+            continue
+        for k, v in (st.get("sections") or {}).items():
+            if isinstance(v, dict):
+                sections[k] = dict(v, source=f"cached:{fp}")
+    return sections
+
+
+def _emit_result(sections, device_live, note=None):
+    """The ONE driver-parsed stdout line.  ``headline_source`` says
+    whether the top-level value was measured by THIS run ("live") or
+    inherited from prior evidence ("prior") — so a device:true artifact
+    whose sampling section was merely backfilled cannot pass for a fresh
+    measurement (the harvester's validity check keys on this)."""
+    samp = sections.get("sampling") or {}
+    headline = samp.get("seps", 0.0)
+    out = {
+        "metric": "sample_seps",
+        "value": round(headline, 1),
+        "unit": "edges/s",
+        "vs_baseline": round(headline / BASELINE_SEPS, 3),
+        "device": bool(device_live),
+        "headline_source": ("live" if device_live and "source" not in samp
+                            else "prior"),
+        "sections": sections,
+    }
+    if note:
+        out["note"] = note
+    print(json.dumps(out), flush=True)
 
 
 class _SectionRunner:
@@ -80,23 +153,29 @@ class _SectionRunner:
     """
 
     def __init__(self, fingerprint: str, fresh: bool = False):
-        self.state = {"fp": fingerprint, "sections": {}, "attempts": {}}
-        if not fresh and os.path.exists(STATE_PATH):
-            try:
-                prev = json.load(open(STATE_PATH))
-                if prev.get("fp") == fingerprint:
-                    self.state = prev
-                    done = sorted(prev.get("sections", {}))
-                    if done:
-                        log(f"resuming; sections already done: {done}")
-            except Exception:
-                pass
+        self.fp = fingerprint
+        all_states = _load_all_states()
+        if fresh:
+            all_states.pop(fingerprint, None)
+        self.state = all_states.get(
+            fingerprint, {"sections": {}, "attempts": {}})
+        self.state.setdefault("sections", {})
+        self.state.setdefault("attempts", {})
+        done = sorted(self.state["sections"])
+        if done:
+            log(f"resuming; sections already done: {done}")
 
     def _save(self):
         try:
+            # re-read and merge at fingerprint granularity so a concurrent
+            # run under ANOTHER fingerprint (harvester TPU run alongside a
+            # CPU smoke) never loses sections it saved after our init;
+            # only our own fp's entry is overwritten
+            disk = _load_all_states()
+            disk[self.fp] = self.state
             tmp = STATE_PATH + ".tmp"
             with open(tmp, "w") as fh:
-                json.dump(self.state, fh)
+                json.dump({"version": 2, "states": disk}, fh)
             os.replace(tmp, STATE_PATH)
         except Exception:
             pass
@@ -616,7 +695,7 @@ def main():
     _signal.signal(_signal.SIGTERM, lambda *a: sys.exit(143))
 
     stage = {}
-    _watchdog(600.0, stage)
+    _watchdog(float(os.environ.get("QUIVER_BENCH_WATCHDOG_S", "600")), stage)
     import jax
 
     if os.environ.get("JAX_PLATFORMS") == "cpu":
@@ -738,14 +817,16 @@ def main():
                    lambda: bench_serving(topo, feat_dim, classes,
                                          n_requests))
 
-    headline = (sections.get("sampling") or {}).get("seps", 0.0)
-    print(json.dumps({
-        "metric": "sample_seps",
-        "value": round(headline, 1),
-        "unit": "edges/s",
-        "vs_baseline": round(headline / BASELINE_SEPS, 3),
-        "sections": sections,
-    }))
+    # backfill sections this run could not measure from prior evidence
+    # (labeled by source); live results always win.  On accelerators the
+    # prior evidence is real silicon data — on a CPU smoke run it would
+    # be misleading next to CPU-backend numbers, so skip the backfill.
+    if jax.default_backend() != "cpu":
+        merged = _fallback_sections()
+        merged.update(sections)
+    else:
+        merged = dict(sections)
+    _emit_result(merged, device_live=True)
 
 
 if __name__ == "__main__":

@@ -28,14 +28,26 @@ ts = sys.argv[1]
 try:
     lines = [l for l in open(f"/tmp/tpu_runs/bench_{ts}.json") if l.strip()]
     out = json.loads(lines[-1])
-    ok = out.get("value", 0) > 0 and out.get("sections")
+    # a run only counts as harvested if THIS run measured the headline on
+    # a live device — the watchdog's fallback emission (device:false) and
+    # a backfilled headline (headline_source:"prior") both parse but must
+    # NOT stop the retry loop
+    ok = (out.get("value", 0) > 0 and out.get("sections")
+          and out.get("device") is True
+          and out.get("headline_source") == "live")
 except Exception:
     ok = False
 sys.exit(0 if ok else 1)
 EOF
     then
       cp /tmp/tpu_runs/bench_$ts.json /tmp/tpu_runs/bench_FINAL.json
-      echo "[$(date +%H%M%S)] HARVEST COMPLETE -> bench_FINAL.json" >> /tmp/tpu_runs/loop.log
+      # land the evidence IN THE REPO: the driver's end-of-round commit
+      # picks these up even if no interactive session is alive.  The
+      # section results themselves are already in /root/repo/.bench_state.json
+      # (bench.py writes it under the TPU fingerprint as it goes), so a
+      # later driver bench run inherits every finished section either way.
+      cp /tmp/tpu_runs/bench_$ts.json /root/repo/docs/tpu_bench_harvest.json
+      echo "[$(date +%H%M%S)] HARVEST COMPLETE -> bench_FINAL.json + repo docs/tpu_bench_harvest.json" >> /tmp/tpu_runs/loop.log
       exit 0
     fi
     # invalid/partial result: back off before retrying (bench.py resumes

@@ -43,25 +43,49 @@ class SampleOut(NamedTuple):
     eid: Optional[jax.Array] = None  # [B, k] int32 global edge positions
 
 
+def _fmix32(x: jax.Array) -> jax.Array:
+    """murmur3 32-bit finalizer: full avalanche (every input bit flips
+    every output bit with ~1/2 probability)."""
+    x = (x ^ (x >> 16)) * jnp.uint32(0x85EBCA6B)
+    x = (x ^ (x >> 13)) * jnp.uint32(0xC2B2AE35)
+    return x ^ (x >> 16)
+
+
 def _hash_uniform(key: jax.Array, shape) -> jax.Array:
-    """Counter-based uniforms from a few integer-hash rounds (finalizer of
-    splitmix/murmur lineage) — compiles to ~10 elementwise VPU ops, no
-    RNG algorithm HLO at all.
+    """Counter-based uniforms from a keyed integer hash — compiles to
+    ~15 elementwise VPU ops, no RNG algorithm HLO at all.
 
     Escape hatch for backends where even the hardware-RNG lowering is
     slow to compile (``sample_rng="hash"``); statistical quality is ample
     for neighbor subsampling (the reference's curand Philox is likewise a
     counter hash, just with more rounds — ``cuda_random.cu.hpp:12-20``).
+
+    Keying: the FULL key (both 32-bit words of a threefry key; folded
+    words of wider impls) is injected between full-avalanche finalizer
+    rounds, never as an additive counter offset — so two distinct keys
+    produce structurally unrelated streams.  (The round-2 scheme offset
+    ONE shared 2^32 counter stream by a 32-bit fold of the key; keys
+    whose offsets landed near each other replayed identical uniform
+    segments at shifted positions.  Cross-key tests:
+    ``tests/test_sample.py::TestHashUniformCrossKey``.)
     """
-    data = jax.random.key_data(key).astype(jnp.uint32)
-    seed = data.reshape(-1)[-1] + data.reshape(-1)[0] * jnp.uint32(0x9E3779B9)
+    data = jax.random.key_data(key).astype(jnp.uint32).reshape(-1)
+    # fold arbitrary-width key data into two 32-bit words (threefry's two
+    # words pass through untouched, so the whole 64-bit key is mixed in)
+    k0 = data[0::2][0]
+    for w in data[0::2][1:]:
+        k0 = k0 ^ w
+    odd = data[1::2]
+    k1 = data[-1] if odd.size == 0 else odd[0]
+    for w in odd[1:]:
+        k1 = k1 ^ w
     n = 1
     for s in shape:
         n *= s
-    x = jax.lax.iota(jnp.uint32, n).reshape(shape) + seed
-    for c1, c2 in ((0x85EBCA6B, 13), (0xC2B2AE35, 16), (0x27D4EB2F, 15)):
-        x = (x ^ (x >> c2)) * jnp.uint32(c1)
-    x = x ^ (x >> 16)
+    # Weyl-spread counter, then key words between avalanche rounds
+    x = jax.lax.iota(jnp.uint32, n).reshape(shape) * jnp.uint32(0x9E3779B9)
+    x = _fmix32(x ^ k0)
+    x = _fmix32(x ^ k1)
     # 24-bit mantissa -> [0, 1)
     return (x >> 8).astype(jnp.float32) * jnp.float32(1.0 / (1 << 24))
 
