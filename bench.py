@@ -34,6 +34,8 @@ BASELINE_FEATURE_GBS = 14.82  # docs/Introduction_en.md:95
 BASELINE_EPOCH_S = 11.1       # docs/Introduction_en.md:146 (1-GPU quiver)
 BASELINE_REDDIT_SEPS = 33.15e6  # docs/Introduction_en.md:43 ([25,10] UVA)
 
+GATHER_MODES_VERSION = 2  # bump when the gather-mode set changes
+
 PRODUCTS_NODES, PRODUCTS_EDGES = 2_449_029, 123_718_280
 PRODUCTS_TRAIN = 196_615      # ogbn-products train split size
 FANOUT = [15, 10, 5]
@@ -347,7 +349,11 @@ def pick_gather_mode(topo, batch_size, sizes, probe_timeout=420):
         try:
             tuned = json.load(open(tuned_path))
             if (tuned.get("backend") == jax.default_backend()
-                    and tuned.get("gather_mode")):
+                    and tuned.get("gather_mode")
+                    # a tuned file from before the current mode set must
+                    # re-probe: round 3 added "blocked", which a pinned
+                    # "lanes" would otherwise shadow forever
+                    and tuned.get("modes_version") == GATHER_MODES_VERSION):
                 log(f"gather_mode={tuned['gather_mode']} (tuned file)")
                 return tuned["gather_mode"]
         except Exception:
@@ -355,7 +361,7 @@ def pick_gather_mode(topo, batch_size, sizes, probe_timeout=420):
 
     probe_b = min(256, batch_size)
     best_mode, best_dt = "xla", float("inf")
-    for gm in ("pallas", "lanes", "lanes_fused", "xla"):
+    for gm in ("pallas", "blocked", "lanes", "lanes_fused", "xla"):
         try:
             ms = probe_sampler_subprocess(gm, sizes, probe_b,
                                           probe_timeout)
@@ -381,7 +387,8 @@ def pick_gather_mode(topo, batch_size, sizes, probe_timeout=420):
     try:  # persist for future sessions (config auto-loads this)
         with open(tuned_path, "w") as fh:
             json.dump({"gather_mode": best_mode,
-                       "backend": jax.default_backend()}, fh)
+                       "backend": jax.default_backend(),
+                       "modes_version": GATHER_MODES_VERSION}, fh)
     except Exception:
         pass
     return best_mode

@@ -54,7 +54,7 @@ def main():
         print(f"{tag}: {ms:.1f} ms/batch")
         return ms
 
-    results = {gm: ms for gm in ("pallas", "lanes", "lanes_fused", "xla")
+    results = {gm: ms for gm in ("pallas", "blocked", "lanes", "lanes_fused", "xla")
                if (ms := probe(gm)) is not None}
     if not results:
         print("no mode succeeded; nothing written")

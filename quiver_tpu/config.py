@@ -77,10 +77,11 @@ def _load_tuned(cfg: Config):
             return
     except Exception:
         return
-    if (cfg.gather_mode == "auto"
-            and tuned.get("gather_mode") in ("xla", "lanes", "lanes_fused",
-                                             "pallas")):
-        cfg.gather_mode = tuned["gather_mode"]
+    gm = tuned.get("gather_mode")
+    if (cfg.gather_mode == "auto" and isinstance(gm, str)
+            and (gm in ("xla", "lanes", "lanes_fused", "pallas")
+                 or gm.startswith("blocked"))):
+        cfg.gather_mode = gm
     if (cfg.sample_rng == "auto"
             and tuned.get("sample_rng") in ("key", "hash")):
         cfg.sample_rng = tuned["sample_rng"]
@@ -121,9 +122,15 @@ def resolve_gather_mode(gather_mode: str) -> str:
     CPU.
     """
     modes = ("auto", "xla", "lanes", "lanes_fused", "pallas")
-    if gather_mode not in modes:
-        raise ValueError(f"gather_mode must be one of {modes}, got "
-                         f"{gather_mode!r}")
+    if gather_mode not in modes and not (
+            isinstance(gather_mode, str)
+            and gather_mode.startswith("blocked")):
+        raise ValueError(f"gather_mode must be one of {modes} or "
+                         f"'blocked[:U]', got {gather_mode!r}")
+    if gather_mode.startswith("blocked"):
+        from .ops.blockgather import parse_blocked
+
+        parse_blocked(gather_mode)  # validates the :U suffix eagerly
     if gather_mode != "auto":
         return gather_mode
     cfg = get_config()

@@ -1,0 +1,182 @@
+"""Blocked window gather — one covering-block gather serves ALL k draws
+of a seed.
+
+The k draws of one seed all read the same contiguous CSR window
+``indices[start:end)`` (the reference's warp kernel exploits exactly this
+contiguity with warp-wide coalesced loads, ``cuda_random.cu.hpp:8-69``).
+The plain ``lanes`` mode ignores it: every draw pays an independent
+[128]-row probe, 128x the payload per element.  Here, a seed whose
+window spans at most ``U`` 128-lane rows is served by ONE ``[U, 128]``
+block gather + a VPU one-hot select of its k lanes — issuing ``U`` rows
+per seed instead of ``k``.  Seeds whose window spans more rows (the
+degree-biased tail of a power-law frontier; ~13% at U=3 on a
+products-like profile) are compacted into a capped fallback that uses
+the classic per-draw path.  If more than the cap don't fit, the whole
+batch falls back to the classic path via ``lax.cond`` — results are
+bitwise identical on every route, only the traffic changes.
+
+Expected issue-rate win at products scale (fanout [15,10,5], U=3,
+cap=T/4): 2.2x / 1.8x / 1.2x fewer gathered rows per hop.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .fastgather import LANES, element_gather
+
+__all__ = ["blocked_window_gather", "blocked_weighted_positions",
+           "parse_blocked"]
+
+DEFAULT_U = 3
+FALLBACK_FRAC = 0.25
+
+
+def parse_blocked(mode: str) -> int:
+    """``"blocked"`` -> default U; ``"blocked:4"`` -> 4.  Anything else
+    (e.g. the typo ``"blocked4"``) raises instead of silently running
+    with the default block width."""
+    if mode == "blocked":
+        return DEFAULT_U
+    if mode.startswith("blocked:"):
+        u = int(mode.split(":", 1)[1])  # ValueError on a bad suffix
+        if u < 1:
+            raise ValueError(f"blocked:U needs U >= 1, got {mode!r}")
+        return u
+    raise ValueError(
+        f"blocked gather mode must be 'blocked' or 'blocked:U', got "
+        f"{mode!r}")
+
+
+def _fit_split(start, deg, U, B, fallback_frac):
+    """Shared fit test + compaction bookkeeping.
+
+    Returns (r0, fits, nfall, S, seed_of_slot, valid):
+    ``fits[b]`` iff seed b's window [start, start+deg) spans <= U rows of
+    the 128-lane table; non-fitting seeds are compacted into ``S`` slots
+    (``seed_of_slot``, ``valid``).
+    """
+    S = min(max(int(B * fallback_frac), 8), B)
+    r0 = jax.lax.shift_right_logical(start, 7)
+    last = start + jnp.maximum(deg - 1, 0)
+    fits = (jax.lax.shift_right_logical(last, 7) - r0) < U
+    nfall = jnp.sum(~fits)
+    slot = jnp.where(~fits, jnp.cumsum(~fits) - 1, S)
+    seed_of_slot = jnp.zeros((S,), jnp.int32).at[slot].set(
+        jnp.arange(B, dtype=jnp.int32), mode="drop")
+    valid = jnp.arange(S, dtype=jnp.int32) < nfall
+    return r0, fits, nfall, S, seed_of_slot, valid
+
+
+def _block_gather(table2d, r0, B, U):
+    """[B, U*128] covering blocks (rows clipped to the table)."""
+    u_iota = jnp.arange(U, dtype=jnp.int32)
+    rows = jnp.minimum(r0[:, None] + u_iota[None, :], table2d.shape[0] - 1)
+    return jnp.take(table2d, rows, axis=0).reshape(B, U * LANES)
+
+
+def _block_select(blk, rel):
+    """vals[b, j] = blk[b, rel[b, j]] as a one-hot VPU reduction (XLA
+    fuses the compare into the reduce; no [B, k, U*128] intermediate)."""
+    width = blk.shape[1]
+    onehot = rel[..., None] == jax.lax.broadcasted_iota(
+        jnp.int32, (1, 1, width), 2)
+    return jnp.sum(jnp.where(onehot, blk[:, None, :], 0), axis=2,
+                   dtype=blk.dtype)
+
+
+def blocked_window_gather(table2d, start, deg, pos, U=DEFAULT_U,
+                          fallback_frac=FALLBACK_FRAC):
+    """``vals[b, j] = table.flat[start[b] + pos[b, j]]`` where every row
+    b's reads lie in its window ``[start[b], start[b] + deg[b])``.
+
+    Args:
+      table2d: ``[rows, 128]`` (a 128-padded flat table, reshaped).
+      start: ``[B]`` int32 window starts (flat element offsets).
+      deg: ``[B]`` int32 window lengths (0 allowed).
+      pos: ``[B, k]`` int32 in-window positions (garbage rows allowed
+        where the caller masks them out; must be in [0, max(deg-1, 0)]).
+    """
+    B, k = pos.shape
+    nrows = table2d.shape[0]
+    r0, fits, nfall, S, seed_of_slot, valid = _fit_split(
+        start, deg, U, B, fallback_frac)
+    idx = start[:, None] + pos
+
+    def blocked(_):
+        blk = _block_gather(table2d, r0, B, U)
+        rel = jnp.clip(idx - (r0[:, None] << 7), 0, U * LANES - 1)
+        vals = _block_select(blk, rel)
+        fb_idx = jnp.take(idx, seed_of_slot, axis=0)
+        fb_idx = jnp.where(valid[:, None], fb_idx, 0)
+        fb_vals = element_gather(table2d, fb_idx)
+        return vals.at[jnp.where(valid, seed_of_slot, B)].set(
+            fb_vals, mode="drop")
+
+    def classic(_):
+        return element_gather(table2d, jnp.clip(idx, 0, nrows * LANES - 1))
+
+    return jax.lax.cond(nfall <= S, blocked, classic, None)
+
+
+def blocked_weighted_positions(cw2d, start, deg, u, U=DEFAULT_U,
+                               fallback_frac=FALLBACK_FRAC,
+                               bits: int = 24):
+    """Weighted draw positions via ONE pass over the gathered CDF block.
+
+    ``cw2d`` is the 128-padded per-row inclusive cumulative-weight table
+    (``row_cumsum_weights``) reshaped ``[rows, 128]``; ``u[b, j]`` is the
+    uniform draw already scaled by the row total.  For a fitting seed the
+    first CDF entry exceeding ``u`` equals the COUNT of in-window entries
+    ``<= u`` (the CDF is nondecreasing within a row) — one masked VPU
+    reduction over the block replaces the classic ``bits``-round binary
+    search of element gathers.  Non-fitting seeds take the classic
+    search, compacted; cap overflow falls back wholesale (lax.cond).
+
+    Returns ``pos[b, j]`` in ``[0, deg[b])`` (garbage where deg == 0;
+    callers mask).
+    """
+    B, k = u.shape
+    nrows = cw2d.shape[0]
+    r0, fits, nfall, S, seed_of_slot, valid = _fit_split(
+        start, deg, U, B, fallback_frac)
+
+    def classic_search(starts, degs, us):
+        """bits-round binary search over cw2d.flat (classic path)."""
+        lo = jnp.broadcast_to(starts[:, None], us.shape)
+        hi = jnp.broadcast_to((starts + degs)[:, None], us.shape)
+
+        def step(_, lohi):
+            lo, hi = lohi
+            mid = (lo + hi) // 2
+            cw = element_gather(cw2d, jnp.clip(mid, 0, nrows * LANES - 1))
+            gt = cw > us
+            return jnp.where(gt, lo, mid + 1), jnp.where(gt, mid, hi)
+
+        lo, hi = jax.lax.fori_loop(0, bits, step, (lo, hi))
+        return jnp.clip(lo - starts[:, None], 0,
+                        jnp.maximum(degs[:, None] - 1, 0))
+
+    def blocked(_):
+        blk = _block_gather(cw2d, r0, B, U)                    # [B, U*128]
+        off = start - (r0 << 7)                                # [B]
+        win = jax.lax.broadcasted_iota(jnp.int32, (1, U * LANES), 1)
+        in_win = ((win >= off[:, None])
+                  & (win < (off + deg)[:, None]))              # [B, W]
+        # count of in-window CDF entries <= u  ->  first-exceed position
+        le = blk[:, None, :] <= u[:, :, None]                  # [B, k, W]
+        cnt = jnp.sum(jnp.where(in_win[:, None, :], le, False), axis=2)
+        pos = jnp.clip(cnt, 0, jnp.maximum(deg[:, None] - 1, 0))
+        pos = pos.astype(jnp.int32)
+        fb_pos = classic_search(
+            jnp.where(valid, jnp.take(start, seed_of_slot), 0),
+            jnp.where(valid, jnp.take(deg, seed_of_slot), 0),
+            jnp.take(u, seed_of_slot, axis=0))
+        return pos.at[jnp.where(valid, seed_of_slot, B)].set(
+            fb_pos, mode="drop")
+
+    def classic(_):
+        return classic_search(start, deg, u)
+
+    return jax.lax.cond(nfall <= S, blocked, classic, None)

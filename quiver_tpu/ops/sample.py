@@ -70,15 +70,16 @@ def _hash_uniform(key: jax.Array, shape) -> jax.Array:
     ``tests/test_sample.py::TestHashUniformCrossKey``.)
     """
     data = jax.random.key_data(key).astype(jnp.uint32).reshape(-1)
-    # fold arbitrary-width key data into two 32-bit words (threefry's two
-    # words pass through untouched, so the whole 64-bit key is mixed in)
-    k0 = data[0::2][0]
-    for w in data[0::2][1:]:
-        k0 = k0 ^ w
-    odd = data[1::2]
-    k1 = data[-1] if odd.size == 0 else odd[0]
-    for w in odd[1:]:
-        k1 = k1 ^ w
+    # fold arbitrary-width key data into two 32-bit words via a
+    # POSITION-SENSITIVE multiplicative chain (a plain XOR fold would
+    # collapse word permutations of 4-word keys — rbg impls — onto one
+    # stream); threefry's two words enter order-distinguished too
+    k0 = jnp.uint32(0)
+    k1 = jnp.uint32(0x9E3779B9)
+    for i, w in enumerate(data):
+        k0 = (k0 ^ w) * jnp.uint32(0x85EBCA6B) + jnp.uint32(i + 1)
+        k1 = ((k1 + w) * jnp.uint32(0xC2B2AE35)) ^ jnp.uint32(
+            (i + 1) * 0x9E3779B9)
     n = 1
     for s in shape:
         n *= s
@@ -100,7 +101,13 @@ def _gather(table: jax.Array, idx: jax.Array, mode: str) -> jax.Array:
     """Element gather dispatch: 'xla' = jnp.take (clipped); 'lanes' = the
     row-gather + lane-select path (``ops.fastgather``) that sidesteps XLA's
     serialized 1-D scalar gather on TPU.  Requires the table to be padded
-    to a multiple of 128 (``CSRTopo.to_device`` guarantees it)."""
+    to a multiple of 128 (``CSRTopo.to_device`` guarantees it).
+
+    'blocked*' applies only to the per-seed WINDOW gathers inside the
+    samplers (``ops.blockgather``); scattered [B] element gathers (the
+    indptr reads) ride the lanes path under it."""
+    if mode.startswith("blocked"):
+        mode = "lanes"
     if mode in ("lanes", "lanes_fused"):
         from .fastgather import element_gather
 
@@ -178,7 +185,18 @@ def sample_neighbors(
 
     mask = j < counts[:, None]
     idx = start[:, None] + pos
-    nbrs = _gather(indices, idx, gather_mode)
+    if gather_mode.startswith("blocked"):
+        from .blockgather import blocked_window_gather, parse_blocked
+
+        assert indices.shape[0] % 128 == 0, (
+            f"blocked gather needs a 128-multiple indices table, got "
+            f"{indices.shape[0]} — pad with ops.fastgather.pad_table_128"
+        )
+        nbrs = blocked_window_gather(
+            indices.reshape(-1, 128), start, deg, pos,
+            U=parse_blocked(gather_mode))
+    else:
+        nbrs = _gather(indices, idx, gather_mode)
     nbrs = jnp.where(mask, nbrs, jnp.int32(-1))
     # global edge positions of the draws: index into CSRTopo.eid / edge-
     # feature arrays.  The reference's CSR carries edge ids for the same
@@ -234,25 +252,47 @@ def sample_neighbors_weighted(
     )
     u = _uniform(key, (B, k), sample_rng) * total[:, None]
 
-    # binary search for first position p in [start, end) with cw[p] > u
-    lo = jnp.broadcast_to(start[:, None], (B, k))
-    hi = jnp.broadcast_to(end[:, None], (B, k))
+    if gather_mode.startswith("blocked"):
+        # CDF inversion AND the neighbor reads both live in the seed's
+        # contiguous window: one block gather + one VPU pass replaces the
+        # ``bits``-round binary search of element gathers (ops.blockgather)
+        from .blockgather import (blocked_weighted_positions,
+                                  blocked_window_gather, parse_blocked)
 
-    def step(_, lohi):
-        # the gather here runs ``bits`` times — with gather_mode="lanes"
-        # each round is a near-bandwidth row gather instead of XLA's
-        # serialized 1-D scalar gather (the dominant cost on TPU)
-        lo, hi = lohi
-        mid = (lo + hi) // 2
-        cw = _gather(cum_weights, mid, gather_mode)
-        gt = cw > u
-        return jnp.where(gt, lo, mid + 1), jnp.where(gt, mid, hi)
+        assert (cum_weights.shape[0] % 128 == 0
+                and indices.shape[0] % 128 == 0), (
+            "blocked gather needs 128-multiple tables — pad with "
+            "ops.fastgather.pad_table_128"
+        )
+        U = parse_blocked(gather_mode)
+        posl = blocked_weighted_positions(
+            cum_weights.reshape(-1, 128), start, deg, u, U=U, bits=bits)
+        # deg <= k: take all neighbors once instead of resampling
+        posl = jnp.where(deg[:, None] <= k, j, posl)
+        posl = jnp.minimum(posl, jnp.maximum(deg[:, None] - 1, 0))
+        pos = start[:, None] + posl
+        nbrs = blocked_window_gather(indices.reshape(-1, 128), start, deg,
+                                     jnp.where(mask, posl, 0), U=U)
+    else:
+        # binary search for first position p in [start, end) with cw[p] > u
+        lo = jnp.broadcast_to(start[:, None], (B, k))
+        hi = jnp.broadcast_to(end[:, None], (B, k))
 
-    lo, hi = jax.lax.fori_loop(0, bits, step, (lo, hi))
-    pos = jnp.clip(lo, start[:, None], jnp.maximum(end[:, None] - 1, 0))
-    # deg <= k: take all neighbors once instead of resampling
-    pos = jnp.where(deg[:, None] <= k, start[:, None] + j, pos)
-    nbrs = _gather(indices, jnp.where(mask, pos, 0), gather_mode)
+        def step(_, lohi):
+            # the gather here runs ``bits`` times — with gather_mode="lanes"
+            # each round is a near-bandwidth row gather instead of XLA's
+            # serialized 1-D scalar gather (the dominant cost on TPU)
+            lo, hi = lohi
+            mid = (lo + hi) // 2
+            cw = _gather(cum_weights, mid, gather_mode)
+            gt = cw > u
+            return jnp.where(gt, lo, mid + 1), jnp.where(gt, mid, hi)
+
+        lo, hi = jax.lax.fori_loop(0, bits, step, (lo, hi))
+        pos = jnp.clip(lo, start[:, None], jnp.maximum(end[:, None] - 1, 0))
+        # deg <= k: take all neighbors once instead of resampling
+        pos = jnp.where(deg[:, None] <= k, start[:, None] + j, pos)
+        nbrs = _gather(indices, jnp.where(mask, pos, 0), gather_mode)
     nbrs = jnp.where(mask, nbrs, jnp.int32(-1))
     eid = jnp.where(mask, pos, jnp.int32(-1))
     return SampleOut(nbrs=nbrs, mask=mask, counts=counts, eid=eid)
