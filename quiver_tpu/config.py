@@ -53,15 +53,16 @@ class Config:
 _config: Optional[Config] = None
 
 
-def _load_tuned(cfg: Config):
+def _load_tuned(cfg: Config, path: Optional[str] = None):
     """Fold in hardware-probed defaults (benchmarks/autotune.py), if any.
     Explicit env vars still win."""
     import json
 
-    path = os.path.join(
-        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-        ".quiver_tpu_tuned.json",
-    )
+    if path is None:
+        path = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            ".quiver_tpu_tuned.json",
+        )
     if not os.path.exists(path):
         return
     try:
@@ -78,9 +79,11 @@ def _load_tuned(cfg: Config):
     except Exception:
         return
     gm = tuned.get("gather_mode")
+    # a malformed tuned value ("blocked:0", "blockedx") is ignored like
+    # every other invalid tuned value, not deferred to crash in
+    # resolve_gather_mode later
     if (cfg.gather_mode == "auto" and isinstance(gm, str)
-            and (gm in ("xla", "lanes", "lanes_fused", "pallas")
-                 or gm.startswith("blocked"))):
+            and gm != "auto" and _is_valid_gather_mode(gm)):
         cfg.gather_mode = gm
     if (cfg.sample_rng == "auto"
             and tuned.get("sample_rng") in ("key", "hash")):
@@ -111,6 +114,22 @@ def resolve_sample_rng(sample_rng: str) -> str:
     return "hash" if jax.default_backend() not in ("cpu",) else "key"
 
 
+def _is_valid_gather_mode(gm: str) -> bool:
+    """One validator shared by the tuned-file loader (which skips invalid
+    values) and resolve_gather_mode (which raises on them)."""
+    if gm in ("auto", "xla", "lanes", "lanes_fused", "pallas"):
+        return True
+    if isinstance(gm, str) and gm.startswith("blocked"):
+        from .ops.blockgather import parse_blocked
+
+        try:
+            parse_blocked(gm)
+        except Exception:
+            return False
+        return True
+    return False
+
+
 def resolve_gather_mode(gather_mode: str) -> str:
     """Map ``"auto"`` to the backend-measured best element-gather mode.
 
@@ -121,16 +140,10 @@ def resolve_gather_mode(gather_mode: str) -> str:
     lanes 27 ms vs xla 237 ms per batch on v5e); plain ``"xla"`` take on
     CPU.
     """
-    modes = ("auto", "xla", "lanes", "lanes_fused", "pallas")
-    if gather_mode not in modes and not (
-            isinstance(gather_mode, str)
-            and gather_mode.startswith("blocked")):
-        raise ValueError(f"gather_mode must be one of {modes} or "
-                         f"'blocked[:U]', got {gather_mode!r}")
-    if gather_mode.startswith("blocked"):
-        from .ops.blockgather import parse_blocked
-
-        parse_blocked(gather_mode)  # validates the :U suffix eagerly
+    if not _is_valid_gather_mode(gather_mode):
+        raise ValueError(
+            f"gather_mode must be one of (auto, xla, lanes, lanes_fused, "
+            f"pallas) or 'blocked[:U]', got {gather_mode!r}")
     if gather_mode != "auto":
         return gather_mode
     cfg = get_config()

@@ -79,7 +79,7 @@ def _hash_uniform(key: jax.Array, shape) -> jax.Array:
     for i, w in enumerate(data):
         k0 = (k0 ^ w) * jnp.uint32(0x85EBCA6B) + jnp.uint32(i + 1)
         k1 = ((k1 + w) * jnp.uint32(0xC2B2AE35)) ^ jnp.uint32(
-            (i + 1) * 0x9E3779B9)
+            ((i + 1) * 0x9E3779B9) & 0xFFFFFFFF)
     n = 1
     for s in shape:
         n *= s

@@ -5,9 +5,12 @@ Backend default on CPU (the test backend): gather_mode="xla",
 sample_rng="key".  The accelerator branch ("lanes"/"hash",
 docs/TPU_MEASUREMENTS.md round 2) can't execute here; the precedence
 logic it shares is what's under test.
-"""
 
-import os
+All env mutation goes through ``monkeypatch`` so it is restored even on
+assertion failure — the round-3 hand-rolled save/restore leaked
+``QUIVER_TPU_SAMPLE_RNG=hash`` into the rest of the pytest session and
+flipped 94 unrelated tests onto the accelerator RNG path.
+"""
 
 import pytest
 
@@ -16,19 +19,19 @@ from quiver_tpu.config import resolve_gather_mode, resolve_sample_rng
 
 
 @pytest.fixture(autouse=True)
-def _clean_config():
+def _clean_config(monkeypatch):
     """Reset the config singleton, scrub env overrides, and disable the
     tuned-file loader around each test (a locally-written
-    .quiver_tpu_tuned.json must not leak into backend-default asserts)."""
-    saved = {k: os.environ.pop(k) for k in
-             ("QUIVER_TPU_GATHER_MODE", "QUIVER_TPU_SAMPLE_RNG")
-             if k in os.environ}
-    saved_loader = qconfig._load_tuned
-    qconfig._load_tuned = lambda cfg: None
+    .quiver_tpu_tuned.json must not leak into backend-default asserts).
+
+    monkeypatch records and restores everything it touches — including
+    deleting vars a test adds via ``monkeypatch.setenv`` — so nothing
+    this module does survives past its own tests."""
+    monkeypatch.delenv("QUIVER_TPU_GATHER_MODE", raising=False)
+    monkeypatch.delenv("QUIVER_TPU_SAMPLE_RNG", raising=False)
+    monkeypatch.setattr(qconfig, "_load_tuned", lambda cfg: None)
     qconfig._config = None
     yield
-    os.environ.update(saved)
-    qconfig._load_tuned = saved_loader
     qconfig._config = None
 
 
@@ -42,17 +45,17 @@ def test_backend_default_cpu():
     assert resolve_sample_rng("auto") == "key"
 
 
-def test_env_overrides_auto():
-    os.environ["QUIVER_TPU_GATHER_MODE"] = "lanes"
-    os.environ["QUIVER_TPU_SAMPLE_RNG"] = "hash"
+def test_env_overrides_auto(monkeypatch):
+    monkeypatch.setenv("QUIVER_TPU_GATHER_MODE", "lanes")
+    monkeypatch.setenv("QUIVER_TPU_SAMPLE_RNG", "hash")
     qconfig._config = None
     assert resolve_gather_mode("auto") == "lanes"
     assert resolve_sample_rng("auto") == "hash"
 
 
-def test_explicit_beats_env():
-    os.environ["QUIVER_TPU_GATHER_MODE"] = "lanes"
-    os.environ["QUIVER_TPU_SAMPLE_RNG"] = "hash"
+def test_explicit_beats_env(monkeypatch):
+    monkeypatch.setenv("QUIVER_TPU_GATHER_MODE", "lanes")
+    monkeypatch.setenv("QUIVER_TPU_SAMPLE_RNG", "hash")
     qconfig._config = None
     assert resolve_gather_mode("xla") == "xla"
     assert resolve_sample_rng("key") == "key"
@@ -65,14 +68,40 @@ def test_invalid_values_raise():
         resolve_sample_rng("Hash")
 
 
-def test_invalid_env_raises_not_silently_defaults():
-    os.environ["QUIVER_TPU_SAMPLE_RNG"] = "keyed"
+def test_invalid_env_raises_not_silently_defaults(monkeypatch):
+    monkeypatch.setenv("QUIVER_TPU_SAMPLE_RNG", "keyed")
     qconfig._config = None
     with pytest.raises(ValueError):
         resolve_sample_rng("auto")
 
 
-def test_sampler_resolves_at_init(small_graph_factory=None):
+# captured at import time, before the autouse fixture stubs the attribute
+_ORIG_LOAD_TUNED = qconfig._load_tuned
+
+
+def test_malformed_tuned_blocked_is_ignored(tmp_path):
+    """A tuned file carrying 'blocked:0' / 'blockedx' must be skipped like
+    any other invalid tuned value, not crash resolve_gather_mode later."""
+    import json
+
+    import jax
+
+    backend = jax.default_backend()
+    p = tmp_path / ".quiver_tpu_tuned.json"
+    for bad in ("blocked:0", "blocked:-2", "blockedx", "blocked:"):
+        p.write_text(json.dumps({"backend": backend, "gather_mode": bad}))
+        cfg = qconfig.Config()
+        _ORIG_LOAD_TUNED(cfg, path=str(p))
+        assert cfg.gather_mode == "auto", bad
+    # a WELL-FORMED blocked value is accepted
+    p.write_text(json.dumps(
+        {"backend": backend, "gather_mode": "blocked:3"}))
+    cfg = qconfig.Config()
+    _ORIG_LOAD_TUNED(cfg, path=str(p))
+    assert cfg.gather_mode == "blocked:3"
+
+
+def test_sampler_resolves_at_init():
     import numpy as np
 
     from quiver_tpu import CSRTopo, GraphSageSampler

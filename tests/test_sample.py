@@ -243,3 +243,73 @@ class TestHashUniformCrossKey:
                    [0x80001234, 0x5678], [0x1234, 0x80005678]):
             o = self._draws(kd, 2048)
             assert np.mean(base == o) < 0.01, kd
+
+
+class TestHashPathExecutesUnderEveryKeyImpl:
+    """The accelerator default is sample_rng='auto' -> 'hash' — so the
+    hash path must EXECUTE (not just trace) for every key width a user
+    can hold: threefry2x32 (2 words, the JAX default), rbg (4 words),
+    and legacy raw uint32 keys.  Round 3's key fold crashed at trace
+    time with OverflowError for every key of >=2 words, which meant the
+    first ``sampler.sample()`` on a real TPU died; these tests pin the
+    fix (``ops/sample.py`` uint32-domain fold)."""
+
+    def _keys(self):
+        out = [
+            ("threefry", jax.random.key(7, impl="threefry2x32")),
+            ("raw", jax.random.PRNGKey(7)),
+        ]
+        try:
+            out.append(("rbg", jax.random.key(7, impl="rbg")))
+        except Exception:  # pragma: no cover - rbg absent on a backend
+            pass
+        return out
+
+    @pytest.mark.parametrize("k", [3])
+    def test_sample_neighbors_hash_executes(self, small_graph, k):
+        indptr, indices = small_graph.to_device()
+        seeds = jnp.arange(16, dtype=jnp.int32)
+        for name, key in self._keys():
+            out = sample_neighbors(indptr, indices, seeds, k, key,
+                                   sample_rng="hash")
+            nbrs = np.asarray(out.nbrs)  # forces execution
+            mask = np.asarray(out.mask)
+            for v in range(16):
+                tn = true_neighbors(small_graph, v)
+                got = nbrs[v][mask[v]].tolist()
+                assert set(got) <= tn, (name, v, got)
+
+    def test_sample_neighbors_weighted_hash_executes(self, small_graph):
+        from quiver_tpu.ops.sample import (row_cumsum_weights,
+                                           sample_neighbors_weighted)
+
+        indptr_h, indices_h = small_graph.indptr, small_graph.indices
+        w = np.random.default_rng(0).random(len(indices_h)).astype(
+            np.float32) + 0.1
+        cw = row_cumsum_weights(jnp.asarray(indptr_h), jnp.asarray(w))
+        indptr, indices = small_graph.to_device()
+        seeds = jnp.arange(12, dtype=jnp.int32)
+        for name, key in self._keys():
+            out = sample_neighbors_weighted(
+                indptr, indices, cw, seeds, 4, key, sample_rng="hash")
+            nbrs = np.asarray(out.nbrs)
+            mask = np.asarray(out.mask)
+            for v in range(12):
+                tn = true_neighbors(small_graph, v)
+                got = [int(x) for x in nbrs[v][mask[v]]]
+                assert set(got) <= tn, (name, v, got)
+
+    def test_hash_uniform_every_width(self):
+        """_hash_uniform executes for 2-word (threefry, typed + raw
+        uint32 dtype path) and 4-word (rbg) key data, and the two widths
+        give distinct streams."""
+        from quiver_tpu.ops.sample import _hash_uniform
+
+        streams = {}
+        for name, key in self._keys():
+            u = np.asarray(_hash_uniform(key, (1024,)))
+            assert (u >= 0).all() and (u < 1).all(), name
+            assert 0.4 < u.mean() < 0.6, name
+            streams[name] = u
+        if "rbg" in streams:
+            assert not np.array_equal(streams["threefry"], streams["rbg"])
