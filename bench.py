@@ -106,9 +106,13 @@ def _fallback_sections():
     except Exception:
         pass
     for fp, st in sorted(_load_all_states().items()):
-        # only probed-mode TPU runs: forced --gather-mode fingerprints
-        # ("|gm=") are A/B artifacts, not interchangeable headline numbers
-        if not fp.startswith("tpu") or "|gm=" in fp:
+        # only probed-mode, FULL-SCALE TPU runs: forced --gather-mode
+        # fingerprints ("|gm=") are A/B artifacts, and small=True smoke
+        # sections (tiny graph) must never lexically override a
+        # small=False products-scale section in this overlay — their
+        # seps would be scored against the products baseline
+        if (not fp.startswith("tpu") or "|gm=" in fp
+                or "small=True" in fp):
             continue
         for k, v in (st.get("sections") or {}).items():
             if isinstance(v, dict):
@@ -116,22 +120,38 @@ def _fallback_sections():
     return sections
 
 
-def _emit_result(sections, device_live, note=None):
+def _emit_result(sections, device_live, note=None, backend=None):
     """The ONE driver-parsed stdout line.  ``headline_source`` says
     whether the top-level value was measured by THIS run ("live") or
     inherited from prior evidence ("prior") — so a device:true artifact
     whose sampling section was merely backfilled cannot pass for a fresh
-    measurement (the harvester's validity check keys on this)."""
+    measurement (the harvester's validity check keys on this).
+
+    Honesty guards:
+      * ``device``/``backend`` reflect the backend THIS process actually
+        initialized — never hardcoded true, so a silent JAX fallback to
+        CPU (tunnel drop between the harvester's probe and bench start)
+        can't pass CPU numbers off as silicon.
+      * a "prior" headline carries ``vs_baseline: null`` at top level —
+        replayed evidence keeps its per-section tags but can never be
+        mistaken for a fresh measurement by anything that consumes only
+        ``value``/``vs_baseline``.
+    """
     samp = sections.get("sampling") or {}
     headline = samp.get("seps", 0.0)
+    # "live" = THIS process measured the headline (even on CPU — the
+    # device/backend fields say where); "prior" = inherited/replayed.
+    # vs_baseline is only meaningful for a live accelerator measurement.
+    source = "live" if samp and "source" not in samp else "prior"
     out = {
         "metric": "sample_seps",
         "value": round(headline, 1),
         "unit": "edges/s",
-        "vs_baseline": round(headline / BASELINE_SEPS, 3),
+        "vs_baseline": (round(headline / BASELINE_SEPS, 3)
+                        if source == "live" and device_live else None),
         "device": bool(device_live),
-        "headline_source": ("live" if device_live and "source" not in samp
-                            else "prior"),
+        "backend": backend,
+        "headline_source": source,
         "sections": sections,
     }
     if note:
@@ -169,16 +189,24 @@ class _SectionRunner:
 
     def _save(self):
         try:
-            # re-read and merge at fingerprint granularity so a concurrent
-            # run under ANOTHER fingerprint (harvester TPU run alongside a
-            # CPU smoke) never loses sections it saved after our init;
-            # only our own fp's entry is overwritten
-            disk = _load_all_states()
-            disk[self.fp] = self.state
-            tmp = STATE_PATH + ".tmp"
-            with open(tmp, "w") as fh:
-                json.dump({"version": 2, "states": disk}, fh)
-            os.replace(tmp, STATE_PATH)
+            # flock serializes the read-merge-replace against a concurrent
+            # bench under ANOTHER fingerprint (harvester TPU run alongside
+            # a CPU smoke): without it two interleaved load/os.replace
+            # pairs can drop the other run's newest sections — the exact
+            # cross-run clobbering the per-fingerprint format prevents
+            import fcntl
+
+            with open(STATE_PATH + ".lock", "w") as lk:
+                fcntl.flock(lk, fcntl.LOCK_EX)
+                try:
+                    disk = _load_all_states()
+                    disk[self.fp] = self.state
+                    tmp = STATE_PATH + ".tmp"
+                    with open(tmp, "w") as fh:
+                        json.dump({"version": 2, "states": disk}, fh)
+                    os.replace(tmp, STATE_PATH)
+                finally:
+                    fcntl.flock(lk, fcntl.LOCK_UN)
         except Exception:
             pass
 
@@ -828,12 +856,17 @@ def main():
     # (labeled by source); live results always win.  On accelerators the
     # prior evidence is real silicon data — on a CPU smoke run it would
     # be misleading next to CPU-backend numbers, so skip the backfill.
-    if jax.default_backend() != "cpu":
+    backend = jax.default_backend()
+    if backend != "cpu":
         merged = _fallback_sections()
         merged.update(sections)
     else:
         merged = dict(sections)
-    _emit_result(merged, device_live=True)
+    # device_live comes from the backend this process ACTUALLY got — if
+    # JAX silently fell back to CPU (tunnel dropped between the
+    # harvester's probe and bench start), the emission says so
+    _emit_result(merged, device_live=(backend not in ("cpu",)),
+                 backend=backend)
 
 
 if __name__ == "__main__":

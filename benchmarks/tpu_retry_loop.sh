@@ -29,11 +29,13 @@ try:
     lines = [l for l in open(f"/tmp/tpu_runs/bench_{ts}.json") if l.strip()]
     out = json.loads(lines[-1])
     # a run only counts as harvested if THIS run measured the headline on
-    # a live device — the watchdog's fallback emission (device:false) and
-    # a backfilled headline (headline_source:"prior") both parse but must
+    # a live device — the watchdog's fallback emission (device:false), a
+    # backfilled headline (headline_source:"prior"), and a silent JAX
+    # fallback to the CPU backend (backend!="tpu") all parse but must
     # NOT stop the retry loop
     ok = (out.get("value", 0) > 0 and out.get("sections")
           and out.get("device") is True
+          and out.get("backend") == "tpu"
           and out.get("headline_source") == "live")
 except Exception:
     ok = False

@@ -114,20 +114,29 @@ def resolve_sample_rng(sample_rng: str) -> str:
     return "hash" if jax.default_backend() not in ("cpu",) else "key"
 
 
-def _is_valid_gather_mode(gm: str) -> bool:
-    """One validator shared by the tuned-file loader (which skips invalid
-    values) and resolve_gather_mode (which raises on them)."""
+def _validate_gather_mode(gm) -> None:
+    """One validator shared by the tuned-file loader (which catches and
+    skips) and resolve_gather_mode (which lets it raise) — keeps
+    parse_blocked's specific diagnostics ("blocked:U needs U >= 1")
+    instead of a generic mode-list message."""
     if gm in ("auto", "xla", "lanes", "lanes_fused", "pallas"):
-        return True
+        return
     if isinstance(gm, str) and gm.startswith("blocked"):
         from .ops.blockgather import parse_blocked
 
-        try:
-            parse_blocked(gm)
-        except Exception:
-            return False
-        return True
-    return False
+        parse_blocked(gm)
+        return
+    raise ValueError(
+        f"gather_mode must be one of (auto, xla, lanes, lanes_fused, "
+        f"pallas) or 'blocked[:U]', got {gm!r}")
+
+
+def _is_valid_gather_mode(gm) -> bool:
+    try:
+        _validate_gather_mode(gm)
+    except Exception:
+        return False
+    return True
 
 
 def resolve_gather_mode(gather_mode: str) -> str:
@@ -140,10 +149,7 @@ def resolve_gather_mode(gather_mode: str) -> str:
     lanes 27 ms vs xla 237 ms per batch on v5e); plain ``"xla"`` take on
     CPU.
     """
-    if not _is_valid_gather_mode(gather_mode):
-        raise ValueError(
-            f"gather_mode must be one of (auto, xla, lanes, lanes_fused, "
-            f"pallas) or 'blocked[:U]', got {gather_mode!r}")
+    _validate_gather_mode(gather_mode)
     if gather_mode != "auto":
         return gather_mode
     cfg = get_config()

@@ -429,13 +429,43 @@ def calibrate_threshold(tpu_sampler, cpu_sampler, feature, apply_fn, params,
             np.asarray(apply_fn(params, x, b.layers))
             tpu_dt = _time.perf_counter() - t0
             points.append((load, cpu_dt, tpu_dt))
-    points.sort()
-    # largest load where CPU still wins (prefix majority)
-    best = 0.0
-    for load, cpu_dt, tpu_dt in points:
-        if cpu_dt <= tpu_dt:
-            best = load
-    return best
+    return _fit_crossover(points)
+
+
+def _fit_crossover(points) -> float:
+    """Threshold from timing points ``(load, cpu_dt, device_dt)``.
+
+    Fit the crossover instead of keeping the LAST load where CPU won:
+    with noisy timings past the crossover a single lucky CPU sample
+    would set the threshold far too high and route heavy requests to
+    the slow lane.  The threshold is the midpoint at the best split
+    (below: CPU lane, at/above: device lane), the max load if CPU
+    always wins, 0 if the device lane always wins.
+    """
+    points = sorted(points)
+    if not points:
+        return 0.0
+    wins = [cpu_dt <= dev_dt for _, cpu_dt, dev_dt in points]
+    # optimal split: the index s maximizing (#CPU wins below s) +
+    # (#device wins at/after s).  Works at any sample count (a rolling
+    # window degenerates to a global vote when n <= window) and a single
+    # outlier on either side moves the optimum only if it outweighs the
+    # consistent pattern.
+    n = len(points)
+    dev_wins_suffix = [0] * (n + 1)
+    for i in range(n - 1, -1, -1):
+        dev_wins_suffix[i] = dev_wins_suffix[i + 1] + (0 if wins[i] else 1)
+    best_s, best_score, cpu_prefix = 0, dev_wins_suffix[0], 0
+    for s in range(1, n + 1):
+        cpu_prefix += 1 if wins[s - 1] else 0
+        score = cpu_prefix + dev_wins_suffix[s]
+        if score > best_score:
+            best_s, best_score = s, score
+    if best_s == 0:
+        return 0.0
+    if best_s == n:
+        return points[-1][0]
+    return (points[best_s - 1][0] + points[best_s][0]) / 2.0
 
 
 class InferenceServer_Debug(InferenceServer):

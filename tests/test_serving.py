@@ -315,3 +315,38 @@ def test_hybrid_sampler_buckets_cpu_lane(small_graph):
     assert shapes[0] == shapes[1] == frontier(4)
     assert shapes[2] == shapes[3] == frontier(8)
     assert shapes[4] == frontier(11)
+
+
+def test_fit_crossover_robust_to_noise():
+    """The threshold fit must not be dragged up by one lucky CPU sample
+    past the crossover (round-3 picked the LAST load where CPU won)."""
+    from quiver_tpu.serving import _fit_crossover
+
+    # clean crossover at load ~100: cpu wins below, device above
+    pts = [(l, 1.0, 2.0) for l in (10, 20, 40, 80)] + \
+          [(l, 3.0, 1.0) for l in (120, 200, 400, 800, 1600)]
+    thr = _fit_crossover(pts)
+    assert 80 <= thr <= 120, thr
+
+    # one lucky CPU sample deep past the crossover must NOT set the
+    # threshold to 1600
+    noisy = pts + [(1600.0001, 0.5, 1.0)]
+    thr = _fit_crossover(noisy)
+    assert thr <= 200, thr
+
+    # degenerate cases
+    assert _fit_crossover([]) == 0.0
+    assert _fit_crossover([(5, 2.0, 1.0)]) == 0.0          # device always
+    assert _fit_crossover([(5, 1.0, 2.0), (9, 1.0, 2.0)]) == 9  # cpu always
+
+
+def test_fit_crossover_small_sample():
+    """With fewer points than any window width, a clean CPU prefix must
+    still yield a positive threshold (not a global-majority 0.0)."""
+    from quiver_tpu.serving import _fit_crossover
+
+    thr = _fit_crossover(
+        [(10, 1, 2), (20, 1, 2), (120, 3, 1), (200, 3, 1), (400, 3, 1)])
+    assert 20 <= thr <= 120, thr
+    thr = _fit_crossover([(10, 1, 2), (120, 3, 1)])
+    assert 10 <= thr <= 120, thr
