@@ -54,7 +54,9 @@ def main():
         print(f"{tag}: {ms:.1f} ms/batch")
         return ms
 
-    results = {gm: ms for gm in ("pallas", "blocked", "lanes", "lanes_fused", "xla")
+    from bench import GATHER_MODES_VERSION, PROBE_MODES
+
+    results = {gm: ms for gm in PROBE_MODES
                if (ms := probe(gm)) is not None}
     if not results:
         print("no mode succeeded; nothing written")
@@ -71,6 +73,9 @@ def main():
         "gather_mode": best,
         "backend": jax.default_backend(),
         "device": str(jax.devices()[0]),
+        # without this tag bench.pick_gather_mode distrusts the file and
+        # re-probes every session (version gate on the mode set)
+        "modes_version": GATHER_MODES_VERSION,
         "probe_ms": {k: round(v, 2) for k, v in results.items()},
     }
     if rng_results:

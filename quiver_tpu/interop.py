@@ -15,7 +15,8 @@ from __future__ import annotations
 
 import numpy as np
 
-__all__ = ["to_torch_adjs", "to_torch", "TorchSampleLoader"]
+__all__ = ["to_torch_adjs", "to_torch", "TorchSampleLoader",
+           "block_specs", "to_dgl_blocks"]
 
 
 def to_torch(x):
@@ -78,3 +79,60 @@ class TorchSampleLoader:
             y = (torch.from_numpy(self.labels[seeds]) if self.labels
                  is not None else None)
             yield n_id, bs, adjs, x, y
+
+
+# --------------------------------------------------------------- DGL side
+# The reference's second-framework integration pairs its Feature store
+# with a DGL training loop (reference examples/dgl/ogbn_products_sage_
+# quiver.py: DGL NeighborSampler + quiver.Feature[input_nodes] + dglnn
+# SAGEConv over MFG "blocks").  Mirrored here in both directions:
+#   * block_specs / to_dgl_blocks: OUR sampler's output as DGL message-
+#     flow-graph blocks, so a dgl.nn model consumes quiver_tpu samples;
+#   * Feature already serves any torch loop via __getitem__ + to_torch —
+#     the reference direction — shown in examples/dgl_products_sage.py.
+# dgl itself stays an optional dependency (lazy import).
+
+def block_specs(batch):
+    """:class:`SampledBatch` -> per-layer MFG specs
+    ``(src, dst, eid, n_src, n_dst)`` (numpy, outermost layer first).
+
+    ``src``/``dst`` are frontier-local endpoints of each sampled edge
+    (dst = the seed-side node), ``n_src``/``n_dst`` the padded frontier
+    sizes — exactly ``dgl.create_block((src, dst), num_src_nodes=n_src,
+    num_dst_nodes=n_dst)``'s contract, where the target frontier is a
+    prefix of the source frontier (DGL's own block invariant).
+
+    ``eid`` is empty unless the sampler was built with
+    ``return_eid=True`` (eid materialization is otherwise DCE'd).
+    """
+    _, _, adjs = batch.to_pyg_adjs()
+    specs = []
+    for edge_index, e_id, (n_src, n_dst) in adjs:
+        # PyG edge_index rows: [0] = neighbour (source), [1] = target
+        specs.append((edge_index[0], edge_index[1], e_id,
+                      int(n_src), int(n_dst)))
+    return specs
+
+
+def to_dgl_blocks(batch):
+    """:class:`SampledBatch` -> list of DGL MFG blocks (outermost first),
+    with sampled edge ids in ``block.edata["_ID"]``.
+
+    Drop-in for the blocks a ``dgl.dataloading.NeighborSampler`` yields,
+    so a dgl.nn model (e.g. ``dglnn.SAGEConv`` with the
+    ``h_dst = h[:block.num_dst_nodes()]`` idiom) trains on quiver_tpu
+    samples unchanged.  Requires dgl (optional dependency).
+    """
+    import dgl
+    import torch
+
+    blocks = []
+    for src, dst, eid, n_src, n_dst in block_specs(batch):
+        b = dgl.create_block(
+            (torch.from_numpy(src.astype(np.int64)),
+             torch.from_numpy(dst.astype(np.int64))),
+            num_src_nodes=n_src, num_dst_nodes=n_dst)
+        if len(eid) == len(src):  # sampler built with return_eid=True
+            b.edata["_ID"] = torch.from_numpy(eid.astype(np.int64))
+        blocks.append(b)
+    return blocks

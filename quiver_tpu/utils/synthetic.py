@@ -59,13 +59,16 @@ def community_graph(n_nodes: int, n_classes: int, intra_deg: int = 6,
     k = intra_deg + inter_deg
     src = np.repeat(np.arange(n_nodes), k)
     dst = np.empty(n_nodes * k, dtype=np.int64)
-    for v in range(n_nodes):
-        c = comm[v]
-        lo, hi = bounds[c], bounds[c + 1]
-        intra = order[rng.integers(lo, max(hi, lo + 1), intra_deg)]
-        inter = rng.integers(0, n_nodes, inter_deg)
-        dst[v * k: v * k + intra_deg] = intra
-        dst[v * k + intra_deg: (v + 1) * k] = inter
+    # vectorized intra draws: uniform position inside each node's own
+    # class slice (fully vectorized so products-scale graphs build in
+    # seconds, not minutes)
+    lo = bounds[comm]
+    hi = np.maximum(bounds[comm + 1], lo + 1)
+    u = rng.random((n_nodes, intra_deg))
+    intra = order[(lo[:, None] + u * (hi - lo)[:, None]).astype(np.int64)]
+    inter = rng.integers(0, n_nodes, (n_nodes, inter_deg))
+    dst.reshape(n_nodes, k)[:, :intra_deg] = intra
+    dst.reshape(n_nodes, k)[:, intra_deg:] = inter
     topo = CSRTopo(edge_index=np.stack([src, dst]), node_count=n_nodes)
     feat = np.eye(n_classes, dtype=np.float32)[comm]
     feat += rng.normal(0, noise, feat.shape).astype(np.float32)
