@@ -244,7 +244,8 @@ class GraphSageSampler:
                  dedup: str = "none", gather_mode: str = "auto",
                  edge_weights=None, return_eid: bool = False,
                  uva_budget: Union[int, str, None] = None,
-                 sample_rng: str = "auto"):
+                 sample_rng: str = "auto", uva_overlap: bool = True,
+                 uva_timings: Optional[dict] = None):
         assert mode in ("TPU", "CPU", "UVA", "GPU"), mode
         if mode == "GPU":  # compat alias from the reference API
             mode = "TPU"
@@ -270,6 +271,11 @@ class GraphSageSampler:
         # workloads — e.g. serving buckets — must not evict each other)
         self._cpu = None
         self.uva_budget = uva_budget
+        # uva_overlap=False serializes the device/host tiers (the A/B
+        # baseline for the overlap claim); uva_timings accumulates the
+        # cold tier's host wall ("host_s") when a dict is passed
+        self.uva_overlap = uva_overlap
+        self.uva_timings = uva_timings
         self._uva = None
         if mode == "UVA":
             assert dedup == "none", "UVA mode: positional pipeline only"
@@ -413,7 +419,8 @@ class GraphSageSampler:
         gm = self.gather_mode
         n_id, n_mask, num, blocks = sample_uva(
             self._uva, self.sizes, input_nodes, key, gather_mode=gm,
-            sample_rng=self.sample_rng
+            sample_rng=self.sample_rng,
+            overlap=self.uva_overlap, timings=self.uva_timings,
         )
         return SampledBatch(
             n_id=jnp.asarray(n_id), n_id_mask=jnp.asarray(n_mask),

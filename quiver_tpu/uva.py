@@ -104,7 +104,7 @@ class UVAGraph:
 
 
 def sample_uva(uva: UVAGraph, sizes, input_nodes, key, gather_mode="xla",
-               sample_rng="auto"):
+               sample_rng="auto", overlap=True, timings=None):
     """Host-driven multi-hop loop over the hot/cold split.
 
     Per hop: device samples the hot rows (dispatched async), the native
@@ -112,7 +112,15 @@ def sample_uva(uva: UVAGraph, sizes, input_nodes, key, gather_mode="xla",
     with the same positional no-dedup relabeling as the TPU pipeline.
     Returns the ``(n_id, n_id_mask, num_nodes, blocks)`` tuple the caller
     wraps into a :class:`SampledBatch`.
+
+    ``overlap=False`` forces the device sync BEFORE the host tier runs —
+    the serialized baseline the overlap claim is measured against
+    (bench's ``sampling_uva`` section reports the A/B as
+    ``overlap_factor``).  ``timings``: optional dict accumulating
+    ``host_s`` (cold-tier wall inside this call) for tier attribution.
     """
+    import time as _time
+
     import jax
     import jax.numpy as jnp
 
@@ -130,6 +138,8 @@ def sample_uva(uva: UVAGraph, sizes, input_nodes, key, gather_mode="xla",
                                seed_mask=jnp.asarray(hot),
                                gather_mode=gather_mode,
                                sample_rng=sample_rng)
+        if not overlap:  # serialized A/B baseline: wait for device first
+            out.nbrs.block_until_ready()
         # ... host tier runs while the device works; its RNG seed derives
         # from the same jax key, so a pinned key replays BOTH tiers
         cold_idx = np.nonzero(fmask & ~hot)[0]
@@ -137,8 +147,12 @@ def sample_uva(uva: UVAGraph, sizes, input_nodes, key, gather_mode="xla",
             hop_seed = int(
                 np.asarray(jax.random.key_data(keys[l])).ravel()[-1]
             )
+            t0 = _time.perf_counter()
             cn, cm, _ = uva.cpu.sample_neighbors(frontier[cold_idx], k,
                                                  seed=hop_seed)
+            if timings is not None:
+                timings["host_s"] = (timings.get("host_s", 0.0)
+                                     + _time.perf_counter() - t0)
         nbrs = np.asarray(out.nbrs).copy()   # sync point
         mask = np.asarray(out.mask).copy()
         if len(cold_idx):
