@@ -355,9 +355,16 @@ print("PROBE_MS", (time.perf_counter() - t0) / 3 * 1e3)
     for line in p.stdout.splitlines():
         if line.startswith("PROBE_MS"):
             return float(line.split()[1])
+    import re
+
     err_lines = (p.stderr or "").strip().splitlines()
-    raise RuntimeError(err_lines[-1] if err_lines
-                       else f"rc={p.returncode}, no output")
+    # last exception-SHAPED line ("SomeError: ..." / "pkg.Exception: ...")
+    # — not JAX's traceback-filtering notice, not trailing runtime log
+    # noise that merely contains the word "error"
+    msg = next((ln for ln in reversed(err_lines)
+                if re.match(r"^[\w.]*(Error|Exception)\b.*:", ln)), None)
+    raise RuntimeError(msg or (err_lines[-1] if err_lines
+                               else f"rc={p.returncode}, no output"))
 
 
 def pick_gather_mode(topo, batch_size, sizes, probe_timeout=420):
