@@ -124,6 +124,17 @@ def _fallback_sections():
     return sections
 
 
+def is_live_harvest(out: dict) -> bool:
+    """THE harvest gate, shared by benchmarks/tpu_retry_loop.sh's
+    validity check and benchmarks/harvest_commit.py so they cannot
+    drift: evidence counts only if THIS run measured the headline on a
+    live TPU backend."""
+    return bool(out.get("value", 0) > 0 and out.get("sections")
+                and out.get("device") is True
+                and out.get("backend") == "tpu"
+                and out.get("headline_source") == "live")
+
+
 def _emit_result(sections, device_live, note=None, backend=None):
     """The ONE driver-parsed stdout line.  ``headline_source`` says
     whether the top-level value was measured by THIS run ("live") or
@@ -358,11 +369,12 @@ print("PROBE_MS", (time.perf_counter() - t0) / 3 * 1e3)
     import re
 
     err_lines = (p.stderr or "").strip().splitlines()
-    # last exception-SHAPED line ("SomeError: ..." / "pkg.Exception: ...")
-    # — not JAX's traceback-filtering notice, not trailing runtime log
-    # noise that merely contains the word "error"
+    # last exception-SHAPED line ("SomeError: ..." / "pkg.Exception: ...",
+    # colon immediately after the name) — not JAX's traceback-filtering
+    # notice, not "Exception ignored in: <...>" interpreter-teardown
+    # noise, not runtime log lines that merely contain the word "error"
     msg = next((ln for ln in reversed(err_lines)
-                if re.match(r"^[\w.]*(Error|Exception)\b.*:", ln)), None)
+                if re.match(r"^[\w.]*(Error|Exception):", ln)), None)
     raise RuntimeError(msg or (err_lines[-1] if err_lines
                                else f"rc={p.returncode}, no output"))
 

@@ -25,18 +25,14 @@ while true; do
     if python - "$ts" << 'EOF'
 import json, sys
 ts = sys.argv[1]
+sys.path.insert(0, "/root/repo")
 try:
+    from bench import is_live_harvest  # ONE gate, shared with
+    # harvest_commit.py: watchdog fallbacks (device:false), backfilled
+    # headlines (headline_source:"prior"), and silent CPU-backend runs
+    # all parse but must NOT stop the retry loop
     lines = [l for l in open(f"/tmp/tpu_runs/bench_{ts}.json") if l.strip()]
-    out = json.loads(lines[-1])
-    # a run only counts as harvested if THIS run measured the headline on
-    # a live device — the watchdog's fallback emission (device:false), a
-    # backfilled headline (headline_source:"prior"), and a silent JAX
-    # fallback to the CPU backend (backend!="tpu") all parse but must
-    # NOT stop the retry loop
-    ok = (out.get("value", 0) > 0 and out.get("sections")
-          and out.get("device") is True
-          and out.get("backend") == "tpu"
-          and out.get("headline_source") == "live")
+    ok = is_live_harvest(json.loads(lines[-1]))
 except Exception:
     ok = False
 sys.exit(0 if ok else 1)

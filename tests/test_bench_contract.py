@@ -106,3 +106,25 @@ class TestSectionRunnerPersistence:
         assert r.state["attempts"]["e2e"] == 0  # rolled back
         # and the section still runs on retry
         assert r.run("e2e", 30, lambda: {"ok": 1}) == {"ok": 1}
+
+
+class TestHarvestGate:
+    """bench.is_live_harvest — the ONE gate shared by the retry loop's
+    validity check and harvest_commit.py."""
+
+    def _base(self):
+        return {"value": 1e7, "sections": {"sampling": {"seps": 1e7}},
+                "device": True, "backend": "tpu",
+                "headline_source": "live"}
+
+    def test_accepts_live_tpu(self):
+        assert bench.is_live_harvest(self._base())
+
+    @pytest.mark.parametrize("patch", [
+        {"device": False}, {"backend": "cpu"}, {"backend": None},
+        {"headline_source": "prior"}, {"value": 0},
+        {"sections": {}},
+    ])
+    def test_rejects_anything_less(self, patch):
+        out = dict(self._base(), **patch)
+        assert not bench.is_live_harvest(out), patch
