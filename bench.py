@@ -884,6 +884,30 @@ def main():
 
             gm = resolve_gather_mode("auto")
         else:
+            # BANK a headline with the library default before the mode
+            # probe: a short tunnel window must not be eaten by 7 probe
+            # subprocesses before any products-scale section lands.  If
+            # the probe then picks a different mode, the invalidation
+            # loop below clears and re-measures; if it picks the same
+            # mode (the measured default), this section is a cache hit.
+            from quiver_tpu.config import resolve_gather_mode
+
+            gm0 = resolve_gather_mode("auto")
+            runner.run(
+                f"sampling_B{batches[0]}", 900,
+                lambda: bench_sampling(topo, batches[0], FANOUT,
+                                       args.iters, gm0))
+            banked = runner.state["sections"].get(f"sampling_B{batches[0]}")
+            prior = sections.get("sampling")
+            # bank only a result genuinely measured under gm0 (a resumed
+            # cache hit may carry another probe's mode — never relabel),
+            # and never regress an already-banked better headline
+            if (banked and banked.get("gather_mode") == gm0
+                    and (not prior or banked["seps"] > prior.get("seps", 0))):
+                sections["sampling"] = dict(
+                    banked,
+                    vs_baseline=round(banked["seps"] / BASELINE_SEPS, 3))
+                runner._save()
             gm = pick_gather_mode(topo, batches[0], FANOUT)
 
         # one section per batch size, so a stall at B=2048 cannot discard
