@@ -102,10 +102,17 @@ class MixedGraphSageSampler:
     def _decide_cpu_share(self, n_tasks: int) -> int:
         if self.mode == "CPU_ONLY":
             return n_tasks
-        if self.mode == "TPU_ONLY" or self.avg_tpu_time is None:
-            return 0 if self.mode == "TPU_ONLY" else min(
-                self.num_workers, n_tasks // 4
-            )
+        if self.mode == "TPU_ONLY":
+            return 0
+        if self.avg_tpu_time is None or self.avg_cpu_time is None:
+            # seeding epoch(s): both lanes must get measured or the
+            # feedback loop can never engage — at least one CPU task
+            # whenever there are two or more (a 2-task job previously
+            # seeded 0 CPU tasks, left avg_cpu_time None forever, and
+            # the next epoch's steady-state path raised on the None)
+            if n_tasks < 2:
+                return 0
+            return min(self.num_workers, max(1, n_tasks // 4))
         # steady state: give CPU workers the share that equalizes finish time
         tpu_rate = 1.0 / max(self.avg_tpu_time, 1e-9)
         cpu_rate = self.num_workers / max(self.avg_cpu_time, 1e-9)

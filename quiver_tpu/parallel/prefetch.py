@@ -38,24 +38,43 @@ class Prefetcher:
     def __iter__(self) -> Iterator:
         q: "queue.Queue" = queue.Queue(maxsize=self.depth)
         exc = []
+        stop = threading.Event()
+
+        def _put_interruptible(item) -> bool:
+            # a consumer that abandons iteration early (break / exception)
+            # stops draining; a plain q.put would then block this worker
+            # forever on the full bounded queue
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
 
         def worker():
             try:
                 for it in self.items:
-                    q.put(self.make_batch(it))
+                    if stop.is_set():
+                        return
+                    if not _put_interruptible(self.make_batch(it)):
+                        return
             except BaseException as e:  # surface on the consumer side
                 exc.append(e)
             finally:
-                q.put(_END)
+                _put_interruptible(_END)
 
         t = threading.Thread(target=worker, daemon=True)
         t.start()
-        while True:
-            out = q.get()
-            if out is _END:
-                break
-            yield out
-        t.join()
+        try:
+            while True:
+                out = q.get()
+                if out is _END:
+                    break
+                yield out
+        finally:
+            stop.set()
+            t.join(timeout=5)
         if exc:
             raise exc[0]
 

@@ -52,3 +52,24 @@ def test_mixed_feedback_steady_state(small_graph):
     # CPU fast, TPU slow -> CPU takes nearly everything
     s.avg_tpu_time, s.avg_cpu_time = 1.0, 1e-4
     assert s._decide_cpu_share(100) >= 95
+
+
+def test_small_job_feedback_engages(small_graph):
+    """A 2-task job must seed BOTH lanes so the time-ratio feedback can
+    engage; the round-4 pre-fix code left avg_cpu_time None and raised
+    TypeError on the second epoch."""
+    job = RangeSampleJob(np.arange(128), 64)  # 2 tasks
+    m = MixedGraphSageSampler(small_graph, [4, 3], job, num_workers=2)
+    seen = set()
+    for _ in range(2):
+        for b, src in m:
+            seen.add(src)
+    assert m.avg_tpu_time is not None and m.avg_cpu_time is not None
+    assert seen == {"tpu", "cpu"}
+
+
+def test_single_task_job_runs_device_only(small_graph):
+    job = RangeSampleJob(np.arange(32), 64)  # 1 task
+    m = MixedGraphSageSampler(small_graph, [4, 3], job, num_workers=2)
+    out = list(m)
+    assert len(out) == 1 and out[0][1] == "tpu"

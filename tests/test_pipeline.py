@@ -129,3 +129,48 @@ def test_fused_step_with_ici_sharded_feature(setup):
     state, loss = step(state, seeds, labels, jnp.ones((B,), bool),
                        jax.random.PRNGKey(1))
     assert np.isfinite(float(loss))
+
+
+def test_prefetcher_early_abandonment_does_not_leak_worker():
+    """Breaking out of a Prefetcher mid-iteration must stop the worker
+    thread (pre-fix: it blocked forever on the full bounded queue)."""
+    import threading
+    import time
+
+    from quiver_tpu.parallel.prefetch import Prefetcher
+
+    made = []
+
+    def make(i):
+        made.append(i)
+        return i
+
+    before = set(threading.enumerate())
+    p = Prefetcher(range(100), make, depth=2)
+    for x in p:
+        if x == 3:
+            break
+    # worker must wind down promptly, not keep producing all 100 items
+    deadline = time.time() + 5
+    def new_alive():
+        return [t for t in threading.enumerate()
+                if t not in before and t.is_alive()]
+    while new_alive() and time.time() < deadline:
+        time.sleep(0.05)
+    assert not new_alive()
+    assert len(made) < 100
+
+
+def test_prefetcher_completes_and_raises():
+    from quiver_tpu.parallel.prefetch import Prefetcher
+
+    assert list(Prefetcher(range(7), lambda i: i * 2, depth=2)) == [
+        0, 2, 4, 6, 8, 10, 12]
+
+    def boom(i):
+        if i == 2:
+            raise ValueError("bad item")
+        return i
+
+    with pytest.raises(ValueError, match="bad item"):
+        list(Prefetcher(range(5), boom, depth=2))
