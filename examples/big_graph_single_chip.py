@@ -13,6 +13,11 @@ Synthetic by default so it runs anywhere:
         --graph-budget 20M --feature-budget 100M
 """
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import argparse
 import time
 

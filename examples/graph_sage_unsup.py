@@ -7,6 +7,11 @@ pairs are sampled edges, negatives are random nodes, loss is
 the sampled-neighborhood encoder.
 """
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import argparse
 import time
 

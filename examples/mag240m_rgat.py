@@ -6,6 +6,11 @@ graph, hetero neighbor sampling, R-GAT.  Synthetic schema-compatible data
 unless the real dataset is wired in.
 """
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import argparse
 import time
 

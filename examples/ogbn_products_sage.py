@@ -11,6 +11,11 @@ Runs on the real dataset when OGB + the data are available
 pipeline is exercisable anywhere (no-egress environments included).
 """
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import argparse
 import time
 
@@ -24,8 +29,10 @@ from quiver_tpu.models import GraphSAGE
 from quiver_tpu.parallel import TrainState, make_train_step
 
 
-def load_dataset(root):
+def load_dataset(root, synthetic_nodes=200_000, force_synthetic=False):
     try:
+        if force_synthetic:
+            raise ImportError("--force-synthetic")
         from ogb.nodeproppred import NodePropPredDataset
 
         ds = NodePropPredDataset("ogbn-products", root=root)
@@ -42,7 +49,7 @@ def load_dataset(root):
     except Exception as e:
         print(f"[synthetic fallback: {e}]")
         rng = np.random.default_rng(0)
-        n, n_cls = 200_000, 47
+        n, n_cls = synthetic_nodes, 47
         comm = rng.integers(0, n_cls, n)
         deg = np.maximum(rng.lognormal(2.5, 1.0, n), 1).astype(np.int64)
         src = np.repeat(np.arange(n), deg)
@@ -68,6 +75,10 @@ def load_dataset(root):
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--root", default="/data/products")
+    ap.add_argument("--synthetic-nodes", type=int, default=200_000,
+                    help="fallback graph size when OGB data is absent")
+    ap.add_argument("--force-synthetic", action="store_true",
+                    help="skip the OGB path outright (deterministic smoke)")
     ap.add_argument("--epochs", type=int, default=3)
     ap.add_argument("--batch-size", type=int, default=1024)
     ap.add_argument("--cache", default="200M",
@@ -78,7 +89,8 @@ def main():
     args = ap.parse_args()
 
     topo, feat, labels, train_idx, valid_idx, _, n_cls = load_dataset(
-        args.root
+        args.root, synthetic_nodes=args.synthetic_nodes,
+        force_synthetic=args.force_synthetic,
     )
     print(f"graph: {topo.node_count:,} nodes, {topo.edge_count:,} edges")
 

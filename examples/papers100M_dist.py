@@ -13,6 +13,11 @@ Runs on whatever mesh is available (8 virtual CPU devices in tests; a real
 slice in production).  Synthetic data unless OGB + dataset present.
 """
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import argparse
 import time
 

@@ -7,8 +7,12 @@ per-host access probabilities from the train split, greedy partitioning,
 artifacts on disk, then at train time PartitionInfo/DistFeature load them.
 """
 
-import argparse
 import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import argparse
 
 import numpy as np
 

@@ -5,6 +5,11 @@ TPU-native counterpart of ``/root/reference/examples/pyg/reddit_quiver.py``
 at ``--root``; synthetic Reddit-scale otherwise.
 """
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import argparse
 import time
 

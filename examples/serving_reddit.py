@@ -7,6 +7,11 @@ sampler lane and big ones to the TPU lane; the inference server runs
 sample -> feature -> model with bucketed shapes and reports tp99.
 """
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import argparse
 import queue
 import threading

@@ -19,6 +19,21 @@ Public API parity map (reference ``srcs/python/quiver/__init__.py:1-21``):
   RequestBatcher/HybridSampler/InferenceServer -> quiver_tpu.serving
 """
 
+import os as _os
+
+if _os.environ.get("JAX_PLATFORMS"):
+    # honor an explicit JAX_PLATFORMS even where a site hook re-exports
+    # its own after env setup: the config API takes final precedence.
+    # No-op unless the var is set; guarded so an already-initialized
+    # backend (user imported jax and touched devices first) never breaks
+    # the import.
+    try:
+        import jax as _jax
+
+        _jax.config.update("jax_platforms", _os.environ["JAX_PLATFORMS"])
+    except Exception:
+        pass
+
 from . import config
 from .utils.topology import CSRTopo, coo_to_csr, parse_size, reindex_feature
 from .utils.mesh import MeshTopo, make_mesh

@@ -33,6 +33,17 @@ EXAMPLES = {
         "--nodes", "1500", "--edges", "15000", "--clients", "2",
         "--requests-per-client", "4",
     ],
+    # (examples/dgl_products_sage.py is smoke-run by
+    # tests/test_interop.py::TestDGLBlocks::test_fallback_sage_learns)
+    "examples/ogbn_products_sage.py": [
+        "--force-synthetic", "--synthetic-nodes", "3000", "--epochs", "1",
+        "--batch-size", "128", "--cache", "10M",
+    ],
+    "examples/big_graph_single_chip.py": [
+        "--nodes", "3000", "--deg", "8", "--dim", "16",
+        "--batch-size", "64", "--steps", "4",
+        "--graph-budget", "60K", "--feature-budget", "100K",
+    ],
 }
 
 
