@@ -22,6 +22,25 @@ def test_shard_csr_by_rows(small_graph):
         )
 
 
+
+def _assert_shard_edges_real(small_graph, seeds, n_id, blk, k):
+    """Shared ground-truth check: every masked neighbor of every seed on
+    every shard is a real edge of the graph."""
+    n_id = np.asarray(n_id)
+    local = np.asarray(blk.nbr_local)
+    m = np.asarray(blk.mask)
+    D, B = seeds.shape
+    for d in range(D):
+        for b in range(B):
+            tgt = seeds[d, b]
+            row = set(small_graph.indices[
+                small_graph.indptr[tgt]: small_graph.indptr[tgt + 1]
+            ].tolist())
+            for j in range(local.shape[-1]):
+                if m[d, b, j]:
+                    assert n_id[d, local[d, b, j]] in row
+
+
 def test_dist_sampler_edges_real(small_graph):
     mesh = make_mesh(("data",))
     s = DistGraphSampler(small_graph, mesh, sizes=[4, 3])
@@ -35,24 +54,17 @@ def test_dist_sampler_edges_real(small_graph):
     # seeds occupy the frontier prefix per shard
     np.testing.assert_array_equal(n_id[:, :B], seeds)
     # spot-check sampled edges against ground truth on each shard
+    blk = blocks[-1]  # innermost hop: targets = seeds
     for d in range(8):
-        blk = blocks[-1]  # innermost hop: targets = seeds
+        assert int(np.asarray(blk.num_targets)[d]) == B
         local = np.asarray(blk.nbr_local)[d]
         m = np.asarray(blk.mask)[d]
-        assert int(np.asarray(blk.num_targets)[d]) == B
         for b in range(B):
             tgt = seeds[d, b]
-            row = set(
-                small_graph.indices[
-                    small_graph.indptr[tgt]: small_graph.indptr[tgt + 1]
-                ].tolist()
-            )
-            deg = len(row)
-            got = [n_id[d, local[b, j]] for j in range(local.shape[1])
-                   if m[b, j]]
-            assert len(got) == min(deg, 4) or deg > 4  # cap overflow only
-            for x in got:
-                assert x in row
+            deg = small_graph.indptr[tgt + 1] - small_graph.indptr[tgt]
+            got = m[b].sum()
+            assert got == min(deg, 4) or deg > 4  # cap overflow only
+    _assert_shard_edges_real(small_graph, seeds, n_id, blk, 4)
 
 
 def test_dist_sampler_counts_match_single(small_graph):
@@ -88,3 +100,19 @@ def test_dist_sampler_cap_overflow_drops(small_graph):
     # frontier entries for dropped seeds are masked invalid
     nm = np.asarray(n_mask)
     assert nm.shape[1] == 32 + 32 * 4
+
+
+def test_dist_sampler_hash_rng_executes(small_graph):
+    """sample_rng='hash' (the TPU ship default) through the row-sharded
+    dist sampler's shard_map pipeline: deterministic per key, edges real."""
+    mesh = make_mesh(("data",))
+    s = DistGraphSampler(small_graph, mesh, sizes=[4, 3],
+                         sample_rng="hash")
+    assert s.sample_rng == "hash"
+    seeds = np.random.default_rng(1).integers(
+        0, small_graph.node_count, (8, 8))
+    n_id_a, mask_a, _, blocks = s.sample(seeds, key=11)
+    n_id_b, mask_b, _, _ = s.sample(seeds, key=11)
+    np.testing.assert_array_equal(np.asarray(n_id_a), np.asarray(n_id_b))
+    np.testing.assert_array_equal(np.asarray(mask_a), np.asarray(mask_b))
+    _assert_shard_edges_real(small_graph, seeds, n_id_a, blocks[-1], 4)

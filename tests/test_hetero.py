@@ -52,6 +52,30 @@ def test_hetero_sample_shapes(mag_topo):
     )
 
 
+
+def _assert_block_edges_real(topo, b, blk, max_targets=24):
+    """Shared ground-truth check: every masked (src, dst) in a hetero
+    block is a real edge of its relation; invalid targets sample nothing."""
+    s_t, _, d_t = blk.relation
+    rel_topo = topo.relations[blk.relation]
+    n_src = np.asarray(b.n_id[s_t])
+    n_dst = np.asarray(b.n_id[d_t])
+    m = np.asarray(blk.mask)
+    local = np.asarray(blk.nbr_local)
+    dmask = np.asarray(b.n_id_mask[d_t])
+    for t in range(min(local.shape[0], max_targets)):
+        if not dmask[t]:
+            assert not m[t].any()
+            continue
+        tgt = n_dst[t]
+        row = set(rel_topo.indices[
+            rel_topo.indptr[tgt]: rel_topo.indptr[tgt + 1]
+        ].tolist())
+        for j in range(local.shape[1]):
+            if m[t, j]:
+                assert n_src[local[t, j]] in row
+
+
 def test_hetero_edges_are_real(mag_topo):
     topo, ei = mag_topo
     s = HeteroGraphSageSampler(topo, sizes=3, num_hops=2, seed_type="paper")
@@ -59,24 +83,7 @@ def test_hetero_edges_are_real(mag_topo):
     b = s.sample(seeds, key=jax.random.PRNGKey(1))
     for hop_blocks in b.layers:
         for blk in hop_blocks:
-            s_t, _, d_t = blk.relation
-            rel_topo = topo.relations[blk.relation]
-            n_src = np.asarray(b.n_id[s_t])
-            n_dst = np.asarray(b.n_id[d_t])
-            m = np.asarray(blk.mask)
-            local = np.asarray(blk.nbr_local)
-            dmask = np.asarray(b.n_id_mask[d_t])
-            for t in range(min(local.shape[0], 24)):
-                if not dmask[t]:
-                    assert not m[t].any()
-                    continue
-                tgt = n_dst[t]
-                row = set(rel_topo.indices[
-                    rel_topo.indptr[tgt]: rel_topo.indptr[tgt + 1]
-                ].tolist())
-                for j in range(local.shape[1]):
-                    if m[t, j]:
-                        assert n_src[local[t, j]] in row
+            _assert_block_edges_real(topo, b, blk)
 
 
 def test_rgat_forward(mag_topo, rng):
@@ -192,3 +199,25 @@ def test_rel_attention_matches_manual(mag_topo, rng):
         al = np.exp(e - e.max()); al /= al.sum()
         ref = (al[:, None] * wn).sum(axis=0)
         np.testing.assert_allclose(out[i], ref, rtol=1e-4, atol=1e-5)
+
+
+def test_hetero_hash_rng_executes(mag_topo):
+    """The accelerator-default sample_rng='hash' must EXECUTE through the
+    hetero per-relation hops (every sampler variant ships hash on TPU)."""
+    topo, _ = mag_topo
+    s = HeteroGraphSageSampler(topo, sizes=3, num_hops=2,
+                               seed_type="paper", sample_rng="hash")
+    assert s.sample_rng == "hash"
+    b1 = s.sample(np.arange(12), key=jax.random.PRNGKey(1))
+    b2 = s.sample(np.arange(12), key=jax.random.PRNGKey(1))
+    b3 = s.sample(np.arange(12), key=jax.random.PRNGKey(2))
+    for t in b1.n_id:
+        np.testing.assert_array_equal(np.asarray(b1.n_id[t]),
+                                      np.asarray(b2.n_id[t]))
+    assert any(
+        not np.array_equal(np.asarray(b1.n_id[t]), np.asarray(b3.n_id[t]))
+        for t in b1.n_id)
+    # sampled edges are real under hash too
+    for hop_blocks in b1.layers:
+        for blk in hop_blocks:
+            _assert_block_edges_real(topo, b1, blk, max_targets=12)
