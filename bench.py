@@ -678,7 +678,10 @@ def _serving_setup(topo, dim, classes, hidden):
     from quiver_tpu import Feature, GraphSageSampler
     from quiver_tpu.models import GraphSAGE
 
-    key = (id(topo), dim, classes, hidden)
+    # id(topo) alone is unsafe (a GC'd topo's address can be reused) and
+    # counts alone collide across reseeded same-size graphs; key on both
+    # and hold a strong ref to the keyed topo so its id stays valid
+    key = (id(topo), topo.node_count, topo.edge_count, dim, classes, hidden)
     if _SERVING_CACHE.get("key") == key:
         return _SERVING_CACHE["val"]
     n = topo.node_count
@@ -696,7 +699,7 @@ def _serving_setup(topo, dim, classes, hidden):
     )
     val = dict(sampler=sampler, feature=feature, params=params,
                apply_fn=apply_fn, n=n, cpu=None)
-    _SERVING_CACHE.update(key=key, val=val)
+    _SERVING_CACHE.update(key=key, val=val, topo=topo)
     return val
 
 

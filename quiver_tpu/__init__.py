@@ -31,8 +31,13 @@ if _os.environ.get("JAX_PLATFORMS"):
         import jax as _jax
 
         _jax.config.update("jax_platforms", _os.environ["JAX_PLATFORMS"])
-    except Exception:
-        pass
+    except Exception as _e:  # malformed value or backend already pinned
+        import warnings as _warnings
+
+        _warnings.warn(
+            "JAX_PLATFORMS=%r override did not take (%s); the process may "
+            "run on a different backend" % (_os.environ["JAX_PLATFORMS"], _e)
+        )
 
 from . import config
 from .utils.topology import CSRTopo, coo_to_csr, parse_size, reindex_feature

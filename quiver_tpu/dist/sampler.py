@@ -30,22 +30,54 @@ from ..utils.topology import CSRTopo
 from ..ops.sample import sample_neighbors
 from ..sampler import LayerBlock, SampledBatch
 
-__all__ = ["DistGraphSampler", "shard_csr_by_rows"]
+__all__ = ["DistGraphSampler", "shard_csr_by_rows", "plan_row_shards"]
+
+
+def plan_row_shards(indptr, n_shards: int,
+                    max_local_edges: int = 2**31 - 1):
+    """Plan contiguous, edge-balanced row ranges from ``indptr`` alone.
+
+    Returns ``row_starts`` ([n_shards+1] int64).  Raises if any shard's
+    local edge count would overflow the int32 positions the on-device
+    rebased indptr uses (same guard class as ``uva.py``'s hot tier) —
+    this is the check the papers100M regime (>2^31 total edges,
+    reference benchmarks/ogbn-papers100M/train_quiver_multi_node.py)
+    rests on.  Needs no materialized edge array, so it is testable at
+    any scale.
+    """
+    if n_shards < 1:
+        raise ValueError(f"n_shards must be >= 1, got {n_shards}")
+    indptr = np.asarray(indptr)
+    n = len(indptr) - 1
+    total = int(indptr[-1])
+    target = total / n_shards
+    row_starts = [0]
+    for s in range(1, n_shards):
+        row_starts.append(int(np.searchsorted(indptr, target * s)))
+    row_starts.append(n)
+    row_starts = np.asarray(row_starts, dtype=np.int64)
+    local_edges = indptr[row_starts[1:]] - indptr[row_starts[:-1]]
+    worst = int(local_edges.max())
+    if worst > max_local_edges:
+        need = -(-total // max_local_edges)
+        raise ValueError(
+            f"a row shard holds {worst:,} edges > int32 limit "
+            f"{max_local_edges:,}; use at least ~{need} shards "
+            f"(got {n_shards}) or a smaller graph partition"
+        )
+    if n > max_local_edges:
+        raise ValueError(
+            f"{n:,} nodes overflow the int32 row_starts/frontier ids"
+        )
+    return row_starts
 
 
 def shard_csr_by_rows(topo: CSRTopo, n_shards: int):
     """Split a CSR into ``n_shards`` contiguous row ranges, balanced by
     edge count.  Returns (row_starts [n+1], local indptr list, local
     indices list) — local indptr is rebased to each shard's edge offset."""
-    n = topo.node_count
-    target = topo.edge_count / n_shards
     indptr = topo.indptr
-    row_starts = [0]
-    for s in range(1, n_shards):
-        row_starts.append(
-            int(np.searchsorted(indptr, target * s))
-        )
-    row_starts.append(n)
+    row_starts = plan_row_shards(indptr, n_shards)
     local_indptr, local_indices = [], []
     for s in range(n_shards):
         lo, hi = row_starts[s], row_starts[s + 1]
@@ -54,7 +86,7 @@ def shard_csr_by_rows(topo: CSRTopo, n_shards: int):
         local_indices.append(
             topo.indices[indptr[lo]: indptr[hi]].astype(np.int32)
         )
-    return np.asarray(row_starts, dtype=np.int64), local_indptr, local_indices
+    return row_starts, local_indptr, local_indices
 
 
 class DistGraphSampler:
@@ -97,9 +129,13 @@ class DistGraphSampler:
         r128 = lambda v: -(-v // 128) * 128
         max_ip = r128(max(len(x) for x in lips))
         max_id = r128(max(len(x) for x in lids))
-        pad = lambda a, m: np.pad(a, (0, m - len(a)))
-        ip = np.stack([pad(x, max_ip) for x in lips]).astype(np.int32)
-        ix = np.stack([pad(x, max_id) for x in lids]).astype(np.int32)
+        # indptr pads repeat the final offset (padded "rows" read degree 0,
+        # never negative — mirrors uva.py's hot-tier padding); indices pads
+        # are plain zeros (never dereferenced: counts=min(deg,k) masks them)
+        pad_edge = lambda a, m: np.pad(a, (0, m - len(a)), mode="edge")
+        pad_zero = lambda a, m: np.pad(a, (0, m - len(a)))
+        ip = np.stack([pad_edge(x, max_ip) for x in lips]).astype(np.int32)
+        ix = np.stack([pad_zero(x, max_id) for x in lids]).astype(np.int32)
         sh2 = NamedSharding(mesh, P(axis, None))
         self.indptr_sh = jax.device_put(ip, sh2)
         self.indices_sh = jax.device_put(ix, sh2)

@@ -83,6 +83,18 @@ class MixedGraphSageSampler:
                  frontier_caps=None):
         mode = self._ALIASES.get(mode, mode)
         assert mode in ("TPU_CPU_MIXED", "TPU_ONLY", "CPU_ONLY"), mode
+        if num_workers < 1 and mode != "TPU_ONLY":
+            # with 0 workers the CPU lane cannot run: mixed mode would
+            # silently degenerate (avg_cpu_time stays None, feedback never
+            # engages) and CPU_ONLY would crash mid-epoch in array_split
+            if mode == "CPU_ONLY":
+                raise ValueError("CPU_ONLY requires num_workers >= 1")
+            import warnings
+            warnings.warn(
+                "TPU_CPU_MIXED with num_workers=0 cannot run a CPU lane; "
+                "falling back to TPU_ONLY", stacklevel=2
+            )
+            mode = "TPU_ONLY"
         self.mode = mode
         self.job = sample_job
         self.num_workers = num_workers

@@ -73,3 +73,13 @@ def test_single_task_job_runs_device_only(small_graph):
     m = MixedGraphSageSampler(small_graph, [4, 3], job, num_workers=2)
     out = list(m)
     assert len(out) == 1 and out[0][1] == "tpu"
+
+
+def test_zero_workers_mixed_falls_back_to_tpu_only(small_graph):
+    """num_workers=0 cannot run a CPU lane; mixed mode must degrade
+    loudly to TPU_ONLY instead of silently never engaging feedback."""
+    job = RangeSampleJob(np.arange(128), 64)
+    with pytest.warns(UserWarning, match="TPU_ONLY"):
+        m = MixedGraphSageSampler(small_graph, [4, 3], job, num_workers=0)
+    assert m.mode == "TPU_ONLY"
+    assert all(src == "tpu" for _, src in m)
