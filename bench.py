@@ -34,10 +34,12 @@ BASELINE_FEATURE_GBS = 14.82  # docs/Introduction_en.md:95
 BASELINE_EPOCH_S = 11.1       # docs/Introduction_en.md:146 (1-GPU quiver)
 BASELINE_REDDIT_SEPS = 33.15e6  # docs/Introduction_en.md:43 ([25,10] UVA)
 
-GATHER_MODES_VERSION = 3  # bump when the gather-mode set changes
+GATHER_MODES_VERSION = 4  # bump when the gather-mode set changes
 # probed mode space: VERDICT r3 asked for an on-chip A/B of blocked:U in
-# {2,3,4} vs lanes vs pallas — measured, not docstring-estimated
-PROBE_MODES = ("pallas", "blocked:2", "blocked:3", "blocked:4", "lanes",
+# {2,3,4} vs lanes vs pallas; r5 adds the fused Pallas window-sampling
+# kernel (pwindow:U) — measured, not docstring-estimated
+PROBE_MODES = ("pwindow:2", "pwindow:3", "pwindow:4",
+               "pallas", "blocked:2", "blocked:3", "blocked:4", "lanes",
                "lanes_fused", "xla")
 
 PRODUCTS_NODES, PRODUCTS_EDGES = 2_449_029, 123_718_280
@@ -333,6 +335,11 @@ def probe_sampler_subprocess(gather_mode, sizes, probe_b, timeout,
     Shared by ``pick_gather_mode`` and ``benchmarks/autotune.py``.
     """
     import subprocess
+
+    if gather_mode.startswith("pwindow") and sample_rng == "auto":
+        # pwindow fuses the counter-hash RNG in-kernel; never let a
+        # backend/tuned 'key' resolution disqualify the probe
+        sample_rng = "hash"
 
     here = os.path.dirname(os.path.abspath(__file__))
     src = f"""

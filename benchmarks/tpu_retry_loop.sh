@@ -13,13 +13,13 @@ while true; do
   # quick init probe with hard timeout: is the tunnel up at all?
   if timeout 240 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
     echo "[$ts] tunnel UP - bench" >> /tmp/tpu_runs/loop.log
-    # 18000s > the ~16,000s worst-case sum of per-section bounds (banked
-    # sampling 900 + probe 7x420 + sampling 2x960 + dedup/uva 2x960 +
+    # 19800s > the ~17,300s worst-case sum of per-section bounds (banked
+    # sampling 900 + probe 10x420 + sampling 2x960 + dedup/uva 2x960 +
     # feature 660 + e2e 3x1260 + serving 3x900 + quality 1200 +
     # init/graph): the outer timeout is a last resort, not the per-run
     # pacing (bench.py converts its SIGTERM to a clean SystemExit so
     # section attempt budgets survive; resume makes later attempts cheap)
-    timeout 18000 python /root/repo/bench.py --iters 20 --ab-dedup \
+    timeout 19800 python /root/repo/bench.py --iters 20 --ab-dedup \
       > /tmp/tpu_runs/bench_$ts.json 2> /tmp/tpu_runs/bench_$ts.log
     rc=$?
     echo "[$(date +%H%M%S)] bench rc=$rc" >> /tmp/tpu_runs/loop.log

@@ -90,22 +90,31 @@ def _load_tuned(cfg: Config, path: Optional[str] = None):
         cfg.sample_rng = tuned["sample_rng"]
 
 
-def resolve_sample_rng(sample_rng: str) -> str:
+def resolve_sample_rng(sample_rng: str,
+                       gather_mode: Optional[str] = None) -> str:
     """Map ``"auto"`` to the backend-measured best uniform source.
 
-    Resolution order: explicit kwarg > ``QUIVER_TPU_SAMPLE_RNG`` env /
-    tuned file > backend default.  Backend default (measured on a real
-    v5e, docs/TPU_MEASUREMENTS.md round 2): ``"hash"`` (counter-hash
-    uniforms) on accelerators — the 3-hop pipeline runs 50.8M SEPS with
-    hash vs 34.6M threefry / 31.3M rbg — and ``"key"`` (key-based
-    ``jax.random.uniform``) on CPU, where threefry is fast and tests want
-    reproducible streams.
+    Resolution order: explicit kwarg > gather-mode requirement >
+    ``QUIVER_TPU_SAMPLE_RNG`` env / tuned file > backend default.
+    Backend default (measured on a real v5e, docs/TPU_MEASUREMENTS.md
+    round 2): ``"hash"`` (counter-hash uniforms) on accelerators — the
+    3-hop pipeline runs 50.8M SEPS with hash vs 34.6M threefry / 31.3M
+    rbg — and ``"key"`` (key-based ``jax.random.uniform``) on CPU, where
+    threefry is fast and tests want reproducible streams.
+
+    ``gather_mode`` (the RESOLVED mode, if the caller has one): the
+    fused Pallas window kernel (``pwindow``) only supports the in-kernel
+    counter-hash, so ``auto`` resolves to ``"hash"`` under it regardless
+    of backend — an explicit ``"key"`` still reaches the op and raises
+    there (the user's choice is surfaced, not silently overridden).
     """
     if sample_rng not in ("auto", "key", "hash"):
         raise ValueError(f"sample_rng must be auto|key|hash, got "
                          f"{sample_rng!r}")
     if sample_rng != "auto":
         return sample_rng
+    if gather_mode is not None and gather_mode.startswith("pwindow"):
+        return "hash"
     cfg = get_config()
     if cfg.sample_rng != "auto":
         return resolve_sample_rng(cfg.sample_rng)  # validates env/tuned too
@@ -126,9 +135,14 @@ def _validate_gather_mode(gm) -> None:
 
         parse_blocked(gm)
         return
+    if isinstance(gm, str) and gm.startswith("pwindow"):
+        from .ops.pallas.window_sample_kernel import parse_pwindow
+
+        parse_pwindow(gm)
+        return
     raise ValueError(
         f"gather_mode must be one of (auto, xla, lanes, lanes_fused, "
-        f"pallas) or 'blocked[:U]', got {gm!r}")
+        f"pallas) or 'blocked[:U]' or 'pwindow[:U]', got {gm!r}")
 
 
 def _is_valid_gather_mode(gm) -> bool:

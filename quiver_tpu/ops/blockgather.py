@@ -33,20 +33,25 @@ DEFAULT_U = 3
 FALLBACK_FRAC = 0.25
 
 
-def parse_blocked(mode: str) -> int:
-    """``"blocked"`` -> default U; ``"blocked:4"`` -> 4.  Anything else
-    (e.g. the typo ``"blocked4"``) raises instead of silently running
-    with the default block width."""
-    if mode == "blocked":
-        return DEFAULT_U
-    if mode.startswith("blocked:"):
+def parse_u_mode(mode: str, prefix: str, default: int = DEFAULT_U) -> int:
+    """Parse ``"<prefix>"`` -> ``default`` / ``"<prefix>:4"`` -> 4.
+    Anything else (e.g. the typo ``"blocked4"``) raises instead of
+    silently running with the default block width.  Shared by the
+    ``blocked`` (XLA) and ``pwindow`` (Pallas) window-gather modes."""
+    if mode == prefix:
+        return default
+    if mode.startswith(prefix + ":"):
         u = int(mode.split(":", 1)[1])  # ValueError on a bad suffix
         if u < 1:
-            raise ValueError(f"blocked:U needs U >= 1, got {mode!r}")
+            raise ValueError(f"{prefix}:U needs U >= 1, got {mode!r}")
         return u
     raise ValueError(
-        f"blocked gather mode must be 'blocked' or 'blocked:U', got "
+        f"{prefix} gather mode must be '{prefix}' or '{prefix}:U', got "
         f"{mode!r}")
+
+
+def parse_blocked(mode: str) -> int:
+    return parse_u_mode(mode, "blocked")
 
 
 def _fit_split(start, deg, U, B, fallback_frac):

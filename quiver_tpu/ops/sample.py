@@ -51,6 +51,25 @@ def _fmix32(x: jax.Array) -> jax.Array:
     return x ^ (x >> 16)
 
 
+def _fold_key_words(key: jax.Array):
+    """Fold arbitrary-width PRNG key data into two 32-bit words via a
+    POSITION-SENSITIVE multiplicative chain (a plain XOR fold would
+    collapse word permutations of 4-word keys — rbg impls — onto one
+    stream); threefry's two words enter order-distinguished too.
+
+    Shared by :func:`_hash_uniform` and the fused Pallas window-sampling
+    kernel (``ops/pallas/window_sample_kernel.py``), which reproduces the
+    same uniforms in-kernel."""
+    data = jax.random.key_data(key).astype(jnp.uint32).reshape(-1)
+    k0 = jnp.uint32(0)
+    k1 = jnp.uint32(0x9E3779B9)
+    for i, w in enumerate(data):
+        k0 = (k0 ^ w) * jnp.uint32(0x85EBCA6B) + jnp.uint32(i + 1)
+        k1 = ((k1 + w) * jnp.uint32(0xC2B2AE35)) ^ jnp.uint32(
+            ((i + 1) * 0x9E3779B9) & 0xFFFFFFFF)
+    return k0, k1
+
+
 def _hash_uniform(key: jax.Array, shape) -> jax.Array:
     """Counter-based uniforms from a keyed integer hash — compiles to
     ~15 elementwise VPU ops, no RNG algorithm HLO at all.
@@ -69,17 +88,7 @@ def _hash_uniform(key: jax.Array, shape) -> jax.Array:
     segments at shifted positions.  Cross-key tests:
     ``tests/test_sample.py::TestHashUniformCrossKey``.)
     """
-    data = jax.random.key_data(key).astype(jnp.uint32).reshape(-1)
-    # fold arbitrary-width key data into two 32-bit words via a
-    # POSITION-SENSITIVE multiplicative chain (a plain XOR fold would
-    # collapse word permutations of 4-word keys — rbg impls — onto one
-    # stream); threefry's two words enter order-distinguished too
-    k0 = jnp.uint32(0)
-    k1 = jnp.uint32(0x9E3779B9)
-    for i, w in enumerate(data):
-        k0 = (k0 ^ w) * jnp.uint32(0x85EBCA6B) + jnp.uint32(i + 1)
-        k1 = ((k1 + w) * jnp.uint32(0xC2B2AE35)) ^ jnp.uint32(
-            ((i + 1) * 0x9E3779B9) & 0xFFFFFFFF)
+    k0, k1 = _fold_key_words(key)
     n = 1
     for s in shape:
         n *= s
@@ -97,16 +106,39 @@ def _uniform(key, shape, impl: str):
     return jax.random.uniform(key, shape, dtype=jnp.float32)
 
 
+def _stratified_positions(u: jax.Array, deg: jax.Array, k: int) -> jax.Array:
+    """In-window draw positions ``[B, k]`` from uniforms ``u`` — neighbor
+    slot ``j`` draws from stratum ``[floor(j*deg/k), floor((j+1)*deg/k))``
+    (distinct windows for ``deg > k``, identity for ``deg <= k``).
+
+    Single source of truth for the position math: the XLA samplers and the
+    fused Pallas window kernel (which re-derives the same expressions
+    in-kernel, op for op, so its draws are bitwise identical) both follow
+    this formula."""
+    j = jnp.arange(k, dtype=jnp.int32)[None, :]              # [1, k]
+    degf = deg.astype(jnp.float32)[:, None]                  # [B, 1]
+    # Stratum bounds computed in float to avoid an int64 multiply;
+    # deg < 2^24 holds for any real graph's max degree.
+    lo = jnp.floor(j.astype(jnp.float32) * degf / k)
+    hi = jnp.floor((j + 1).astype(jnp.float32) * degf / k)
+    strat = lo + jnp.floor(u * jnp.maximum(hi - lo, 1.0))
+    pos = jnp.where(deg[:, None] <= k, j, strat.astype(jnp.int32))
+    return jnp.minimum(pos.astype(jnp.int32),
+                       jnp.maximum(deg[:, None] - 1, 0))
+
+
 def _gather(table: jax.Array, idx: jax.Array, mode: str) -> jax.Array:
     """Element gather dispatch: 'xla' = jnp.take (clipped); 'lanes' = the
     row-gather + lane-select path (``ops.fastgather``) that sidesteps XLA's
     serialized 1-D scalar gather on TPU.  Requires the table to be padded
     to a multiple of 128 (``CSRTopo.to_device`` guarantees it).
 
-    'blocked*' applies only to the per-seed WINDOW gathers inside the
-    samplers (``ops.blockgather``); scattered [B] element gathers (the
-    indptr reads) ride the lanes path under it."""
-    if mode.startswith("blocked"):
+    'blocked*'/'pwindow*' apply only to the per-seed WINDOW gathers inside
+    the samplers (``ops.blockgather`` / the fused Pallas window kernel);
+    scattered [B] element gathers (the indptr reads) ride the lanes path
+    under them — per-element DMA of indptr rows is the measured-losing
+    pattern (docs/TPU_MEASUREMENTS.md: 26 ms/1M, issue-latency bound)."""
+    if mode.startswith("blocked") or mode.startswith("pwindow"):
         mode = "lanes"
     if mode in ("lanes", "lanes_fused"):
         from .fastgather import element_gather
@@ -173,19 +205,35 @@ def sample_neighbors(
     counts = jnp.minimum(deg, k).astype(jnp.int32)
 
     j = jnp.arange(k, dtype=jnp.int32)[None, :]              # [1, k]
-    degf = deg.astype(jnp.float32)[:, None]                  # [B, 1]
-    # Stratum bounds for the deg > k case (computed in float to avoid an
-    # int64 multiply; deg < 2^24 holds for any real graph's max degree).
-    lo = jnp.floor(j.astype(jnp.float32) * degf / k)
-    hi = jnp.floor((j + 1).astype(jnp.float32) * degf / k)
     u = _uniform(key, (B, k), sample_rng)
-    strat = lo + jnp.floor(u * jnp.maximum(hi - lo, 1.0))
-    pos = jnp.where(deg[:, None] <= k, j, strat.astype(jnp.int32))
-    pos = jnp.minimum(pos.astype(jnp.int32), jnp.maximum(deg[:, None] - 1, 0))
+    pos = _stratified_positions(u, deg, k)
 
     mask = j < counts[:, None]
     idx = start[:, None] + pos
-    if gather_mode.startswith("blocked"):
+    if gather_mode.startswith("pwindow"):
+        # fully-fused Pallas hop: PRNG + positions + window DMA + select
+        # in one kernel — pos above survives only as the eid formula
+        # (dead-code-eliminated when eid is unused downstream)
+        from .pallas.window_sample_kernel import (pallas_window_sample,
+                                                  parse_pwindow)
+
+        assert indices.shape[0] % 128 == 0, (
+            f"pwindow gather needs a 128-multiple indices table, got "
+            f"{indices.shape[0]} — pad with ops.fastgather.pad_table_128"
+        )
+        if sample_rng != "hash":
+            raise ValueError(
+                "gather_mode='pwindow' fuses the counter-hash RNG "
+                "in-kernel and requires sample_rng='hash' (the "
+                "accelerator default); got sample_rng="
+                f"{sample_rng!r}")
+        nbrs = pallas_window_sample(
+            indices.reshape(-1, 128), start, deg, key, k,
+            U=parse_pwindow(gather_mode),
+            # mosaic needs a real TPU; CPU runs ride interpret mode so
+            # rehearsals and the virtual-mesh dryrun execute the same code
+            interpret=jax.default_backend() == "cpu")
+    elif gather_mode.startswith("blocked"):
         from .blockgather import blocked_window_gather, parse_blocked
 
         assert indices.shape[0] % 128 == 0, (

@@ -113,3 +113,17 @@ def test_sampler_resolves_at_init():
     assert s.gather_mode == "xla" and s.sample_rng == "key"
     b = s.sample(np.arange(8, dtype=np.int32))
     assert int(b.num_nodes) >= 8
+
+
+def test_auto_rng_resolves_hash_under_pwindow(monkeypatch):
+    """gather_mode='pwindow' only supports the in-kernel counter-hash;
+    'auto' must resolve to 'hash' under it even on CPU (where auto
+    otherwise resolves to 'key')."""
+    from quiver_tpu.config import resolve_sample_rng
+
+    assert resolve_sample_rng("auto", "pwindow") == "hash"
+    assert resolve_sample_rng("auto", "pwindow:2") == "hash"
+    # explicit choice is surfaced, not overridden (the op raises)
+    assert resolve_sample_rng("key", "pwindow") == "key"
+    # other modes keep the backend default (cpu -> key in this suite)
+    assert resolve_sample_rng("auto", "lanes") == "key"

@@ -146,3 +146,28 @@ def test_lanes_fused_equals_xla(small_graph):
             _np.asarray(_gather(table, idx, "lanes")),
             _np.asarray(jnp.take(table, idx)),
         )
+
+
+def test_pwindow_equals_xla_through_sampler(small_graph):
+    """The fused Pallas window-sampling hop (gather_mode='pwindow')
+    samples bitwise identically to the XLA hash path through the full
+    2-hop sampler (interpret mode on CPU)."""
+    seeds = np.arange(24, dtype=np.int64)
+    key = jax.random.PRNGKey(9)
+    b_x = GraphSageSampler(small_graph, [5, 4], gather_mode="xla",
+                           sample_rng="hash").sample(seeds, key=key)
+    b_p = GraphSageSampler(small_graph, [5, 4], gather_mode="pwindow:2",
+                           sample_rng="hash").sample(seeds, key=key)
+    np.testing.assert_array_equal(np.asarray(b_x.n_id),
+                                  np.asarray(b_p.n_id))
+    for lx, lp in zip(b_x.layers, b_p.layers):
+        np.testing.assert_array_equal(np.asarray(lx.mask),
+                                      np.asarray(lp.mask))
+        np.testing.assert_array_equal(np.asarray(lx.nbr_local),
+                                      np.asarray(lp.nbr_local))
+
+
+def test_pwindow_requires_hash_rng(small_graph):
+    with pytest.raises(ValueError, match="hash"):
+        GraphSageSampler(small_graph, [4], gather_mode="pwindow",
+                         sample_rng="key").sample(np.arange(8))
