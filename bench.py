@@ -336,11 +336,6 @@ def probe_sampler_subprocess(gather_mode, sizes, probe_b, timeout,
     """
     import subprocess
 
-    if gather_mode.startswith("pwindow") and sample_rng == "auto":
-        # pwindow fuses the counter-hash RNG in-kernel; never let a
-        # backend/tuned 'key' resolution disqualify the probe
-        sample_rng = "hash"
-
     here = os.path.dirname(os.path.abspath(__file__))
     src = f"""
 import os, sys, time

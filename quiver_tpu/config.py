@@ -153,7 +153,8 @@ def _is_valid_gather_mode(gm) -> bool:
     return True
 
 
-def resolve_gather_mode(gather_mode: str) -> str:
+def resolve_gather_mode(gather_mode: str,
+                        sample_rng: Optional[str] = None) -> str:
     """Map ``"auto"`` to the backend-measured best element-gather mode.
 
     Resolution order: explicit kwarg > ``QUIVER_TPU_GATHER_MODE`` env /
@@ -162,16 +163,29 @@ def resolve_gather_mode(gather_mode: str) -> str:
     scalar gather serializes (docs/TPU_MEASUREMENTS.md round 2: 3-hop
     lanes 27 ms vs xla 237 ms per batch on v5e); plain ``"xla"`` take on
     CPU.
+
+    ``sample_rng`` (the caller's RAW kwarg): when ``auto`` resolution
+    lands on the Pallas ``pwindow`` kernel (hash-RNG-only) but the user
+    explicitly asked for ``sample_rng="key"``, the choice degrades to
+    the equivalent XLA ``blocked`` window mode instead of crashing a
+    config the user never chose.  An EXPLICIT ``gather_mode="pwindow"``
+    with ``"key"`` still raises at the op (the user's own combination is
+    surfaced, not rewritten).
     """
     _validate_gather_mode(gather_mode)
     if gather_mode != "auto":
         return gather_mode
     cfg = get_config()
     if cfg.gather_mode != "auto":
-        return resolve_gather_mode(cfg.gather_mode)
-    import jax
+        resolved = resolve_gather_mode(cfg.gather_mode)
+    else:
+        import jax
 
-    return "lanes" if jax.default_backend() not in ("cpu",) else "xla"
+        resolved = "lanes" if jax.default_backend() not in ("cpu",) \
+            else "xla"
+    if resolved.startswith("pwindow") and sample_rng == "key":
+        resolved = "blocked" + resolved[len("pwindow"):]
+    return resolved
 
 
 def get_config() -> Config:

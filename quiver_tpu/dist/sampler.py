@@ -116,7 +116,7 @@ class DistGraphSampler:
         self.topo = topo
         self.mesh = mesh
         self.axis = axis
-        self.gather_mode = resolve_gather_mode(gather_mode)
+        self.gather_mode = resolve_gather_mode(gather_mode, sample_rng)
         self.sample_rng = resolve_sample_rng(sample_rng, self.gather_mode)
         self.sizes = list(sizes)
         self.n = int(mesh.shape[axis])

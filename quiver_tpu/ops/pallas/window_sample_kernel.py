@@ -43,18 +43,16 @@ from jax.experimental.pallas import tpu as pltpu
 
 __all__ = ["pallas_window_sample", "parse_pwindow"]
 
+from ..blockgather import DEFAULT_U, FALLBACK_FRAC
+# the kernel body re-derives the XLA hash path with the SAME finalizer
+# and constants — imported, never copied, so they cannot diverge
+from ..sample import HASH_PHI, _fmix32
+
 LANES = 128
 SUB = 64      # seeds per stage = DMAs in flight per buffer
 STAGES = 4    # stages per grid program (static unroll)
 SPP = SUB * STAGES  # seeds per program
 NBUF = 2      # double buffering
-
-DEFAULT_U = 3
-FALLBACK_FRAC = 0.25
-
-_PHI = 0x9E3779B9
-_MUL1 = 0x85EBCA6B
-_MUL2 = 0xC2B2AE35
 
 
 def parse_pwindow(mode: str) -> int:
@@ -62,12 +60,6 @@ def parse_pwindow(mode: str) -> int:
     from ..blockgather import parse_u_mode
 
     return parse_u_mode(mode, "pwindow", DEFAULT_U)
-
-
-def _fmix32(x):
-    x = (x ^ (x >> 16)) * jnp.uint32(_MUL1)
-    x = (x ^ (x >> 13)) * jnp.uint32(_MUL2)
-    return x ^ (x >> 16)
 
 
 def _make_kernel(k: int, kpad: int, U: int):
@@ -114,7 +106,7 @@ def _make_kernel(k: int, kpad: int, U: int):
                  + jnp.uint32(st * SUB) + e_iota)              # [SUB, 1]
             j_iota = jax.lax.broadcasted_iota(jnp.int32, (1, kpad), 1)
             counter = b * jnp.uint32(k) + j_iota.astype(jnp.uint32)
-            x = counter * jnp.uint32(_PHI)
+            x = counter * jnp.uint32(HASH_PHI)
             x = _fmix32(x ^ k0)
             x = _fmix32(x ^ k1)
             u = (x >> 8).astype(jnp.float32) * jnp.float32(1.0 / (1 << 24))

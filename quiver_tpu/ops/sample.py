@@ -43,11 +43,20 @@ class SampleOut(NamedTuple):
     eid: Optional[jax.Array] = None  # [B, k] int32 global edge positions
 
 
+# counter-hash constants — single source for the XLA path AND the fused
+# Pallas window kernel, whose bitwise-identical-draws contract rests on
+# never letting these diverge (ops/pallas/window_sample_kernel.py)
+HASH_PHI = 0x9E3779B9    # Weyl increment (golden-ratio word)
+HASH_MUL1 = 0x85EBCA6B   # murmur3 finalizer multipliers
+HASH_MUL2 = 0xC2B2AE35
+
+
 def _fmix32(x: jax.Array) -> jax.Array:
     """murmur3 32-bit finalizer: full avalanche (every input bit flips
-    every output bit with ~1/2 probability)."""
-    x = (x ^ (x >> 16)) * jnp.uint32(0x85EBCA6B)
-    x = (x ^ (x >> 13)) * jnp.uint32(0xC2B2AE35)
+    every output bit with ~1/2 probability).  Plain jnp elementwise ops —
+    legal both under jit and inside a Pallas kernel body."""
+    x = (x ^ (x >> 16)) * jnp.uint32(HASH_MUL1)
+    x = (x ^ (x >> 13)) * jnp.uint32(HASH_MUL2)
     return x ^ (x >> 16)
 
 
@@ -62,11 +71,11 @@ def _fold_key_words(key: jax.Array):
     same uniforms in-kernel."""
     data = jax.random.key_data(key).astype(jnp.uint32).reshape(-1)
     k0 = jnp.uint32(0)
-    k1 = jnp.uint32(0x9E3779B9)
+    k1 = jnp.uint32(HASH_PHI)
     for i, w in enumerate(data):
-        k0 = (k0 ^ w) * jnp.uint32(0x85EBCA6B) + jnp.uint32(i + 1)
-        k1 = ((k1 + w) * jnp.uint32(0xC2B2AE35)) ^ jnp.uint32(
-            ((i + 1) * 0x9E3779B9) & 0xFFFFFFFF)
+        k0 = (k0 ^ w) * jnp.uint32(HASH_MUL1) + jnp.uint32(i + 1)
+        k1 = ((k1 + w) * jnp.uint32(HASH_MUL2)) ^ jnp.uint32(
+            ((i + 1) * HASH_PHI) & 0xFFFFFFFF)
     return k0, k1
 
 
@@ -93,7 +102,7 @@ def _hash_uniform(key: jax.Array, shape) -> jax.Array:
     for s in shape:
         n *= s
     # Weyl-spread counter, then key words between avalanche rounds
-    x = jax.lax.iota(jnp.uint32, n).reshape(shape) * jnp.uint32(0x9E3779B9)
+    x = jax.lax.iota(jnp.uint32, n).reshape(shape) * jnp.uint32(HASH_PHI)
     x = _fmix32(x ^ k0)
     x = _fmix32(x ^ k1)
     # 24-bit mantissa -> [0, 1)

@@ -254,7 +254,7 @@ class GraphSageSampler:
         assert dedup in ("none", "hop"), dedup
         from .config import resolve_gather_mode, resolve_sample_rng
 
-        self.gather_mode = resolve_gather_mode(gather_mode)
+        self.gather_mode = resolve_gather_mode(gather_mode, sample_rng)
         self.sample_rng = resolve_sample_rng(sample_rng, self.gather_mode)
         self.return_eid = return_eid
         self.csr_topo = csr_topo

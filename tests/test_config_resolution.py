@@ -127,3 +127,19 @@ def test_auto_rng_resolves_hash_under_pwindow(monkeypatch):
     assert resolve_sample_rng("key", "pwindow") == "key"
     # other modes keep the backend default (cpu -> key in this suite)
     assert resolve_sample_rng("auto", "lanes") == "key"
+
+
+def test_auto_gather_degrades_pwindow_for_explicit_key_rng(monkeypatch):
+    """A tuned/env 'pwindow' pick must not crash a user who explicitly
+    chose sample_rng='key': auto resolution degrades to the equivalent
+    XLA blocked mode.  An explicit pwindow+key still raises at the op."""
+    from quiver_tpu import config as qc
+
+    monkeypatch.setenv("QUIVER_TPU_GATHER_MODE", "pwindow:3")
+    monkeypatch.setattr(qc, "_config", None)
+    assert qc.resolve_gather_mode("auto", "key") == "blocked:3"
+    assert qc.resolve_gather_mode("auto", "hash") == "pwindow:3"
+    assert qc.resolve_gather_mode("auto", "auto") == "pwindow:3"
+    # explicit kwarg is never rewritten
+    assert qc.resolve_gather_mode("pwindow:3", "key") == "pwindow:3"
+    monkeypatch.setattr(qc, "_config", None)
