@@ -71,7 +71,6 @@ def main():
 
     payload = {
         "gather_mode": best,
-        "backend": jax.default_backend(),
         "device": str(jax.devices()[0]),
         # without this tag bench.pick_gather_mode distrusts the file and
         # re-probes every session (version gate on the mode set)
@@ -83,9 +82,12 @@ def main():
         payload["rng_probe_ms"] = {
             k: round(v, 2) for k, v in rng_results.items()
         }
-    with open(TUNED_PATH, "w") as f:
-        json.dump(payload, f, indent=2)
-    print(f"tuned defaults -> {TUNED_PATH}: {payload}")
+    # merge (bench.merge_tuned) so a dedup winner persisted by the e2e
+    # A/B survives an autotune re-run
+    from bench import merge_tuned
+
+    written = merge_tuned(payload, jax.default_backend(), TUNED_PATH)
+    print(f"tuned defaults -> {TUNED_PATH}: {written}")
 
 
 if __name__ == "__main__":

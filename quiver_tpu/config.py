@@ -34,7 +34,7 @@ class Config:
     sample_rng: str = field(
         default_factory=lambda: _env("SAMPLE_RNG", "auto")
     )
-    dedup: str = field(default_factory=lambda: _env("DEDUP", "none"))
+    dedup: str = field(default_factory=lambda: _env("DEDUP", "auto"))
     # feature store
     cache_policy: str = field(
         default_factory=lambda: _env("CACHE_POLICY", "device_replicate")
@@ -88,6 +88,11 @@ def _load_tuned(cfg: Config, path: Optional[str] = None):
     if (cfg.sample_rng == "auto"
             and tuned.get("sample_rng") in ("key", "hash")):
         cfg.sample_rng = tuned["sample_rng"]
+    if cfg.dedup == "auto" and tuned.get("dedup") in ("none", "hop"):
+        # written by bench.py's on-chip e2e none-vs-hop A/B — the
+        # full-pipeline measurement, not the sampling microbenchmark
+        # (the CPU dress rehearsal showed they can disagree)
+        cfg.dedup = tuned["dedup"]
 
 
 def resolve_sample_rng(sample_rng: str,
@@ -121,6 +126,25 @@ def resolve_sample_rng(sample_rng: str,
     import jax
 
     return "hash" if jax.default_backend() not in ("cpu",) else "key"
+
+
+def resolve_dedup(dedup: str) -> str:
+    """Map ``"auto"`` to the measured frontier-dedup default.
+
+    Resolution order: explicit kwarg > ``QUIVER_TPU_DEDUP`` env / tuned
+    file (written by bench.py's on-chip e2e none-vs-hop A/B) > "none"
+    (the positional-relabel hot path — round-2's sampling
+    microbenchmarks; the e2e A/B may overturn it, which is exactly what
+    the tuned overlay is for).
+    """
+    if dedup not in ("auto", "none", "hop"):
+        raise ValueError(f"dedup must be auto|none|hop, got {dedup!r}")
+    if dedup != "auto":
+        return dedup
+    cfg = get_config()
+    if cfg.dedup != "auto":
+        return resolve_dedup(cfg.dedup)
+    return "none"
 
 
 def _validate_gather_mode(gm) -> None:

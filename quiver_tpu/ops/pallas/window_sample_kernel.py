@@ -109,7 +109,10 @@ def _make_kernel(k: int, kpad: int, U: int):
             x = counter * jnp.uint32(HASH_PHI)
             x = _fmix32(x ^ k0)
             x = _fmix32(x ^ k1)
-            u = (x >> 8).astype(jnp.float32) * jnp.float32(1.0 / (1 << 24))
+            # Mosaic has no uint32->f32 cast; x>>8 < 2^24 so the int32
+            # detour is value-exact (bitwise = the XLA path's direct cast)
+            u = ((x >> 8).astype(jnp.int32).astype(jnp.float32)
+                 * jnp.float32(1.0 / (1 << 24)))
             degf = deg.astype(jnp.float32)                    # [SUB, 1]
             jf = j_iota.astype(jnp.float32)
             lo = jnp.floor(jf * degf / k)

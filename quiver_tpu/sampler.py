@@ -225,9 +225,12 @@ class GraphSageSampler:
       mode: ``"TPU"`` (jit, default) or ``"CPU"`` (native host sampler).
       frontier_caps: optional per-layer cap on the padded frontier size
         (see module docstring).  Only meaningful with ``dedup="hop"``.
-      dedup: ``"none"`` (default, TPU hot path — positional relabel, no
-        sort; frontier may contain duplicate nodes) or ``"hop"``
-        (reference-parity exact dedup each hop via ``ops.reindex``).
+      dedup: ``"auto"`` (default — the measured library default:
+        ``config.resolve_dedup``, overridable by the tuned file written
+        from bench.py's on-chip e2e A/B), ``"none"`` (TPU hot path —
+        positional relabel, no sort; frontier may contain duplicate
+        nodes) or ``"hop"`` (reference-parity exact dedup each hop via
+        ``ops.reindex``).
       edge_weights: optional ``[E]`` weights; hops then draw neighbors
         weight-proportionally WITH replacement
         (``ops.sample_neighbors_weighted``, reference weight_sample path).
@@ -241,7 +244,7 @@ class GraphSageSampler:
     def __init__(self, csr_topo: CSRTopo, sizes: Sequence[int], device=None,
                  mode: str = "TPU",
                  frontier_caps: Optional[Sequence[Optional[int]]] = None,
-                 dedup: str = "none", gather_mode: str = "auto",
+                 dedup: str = "auto", gather_mode: str = "auto",
                  edge_weights=None, return_eid: bool = False,
                  uva_budget: Union[int, str, None] = None,
                  sample_rng: str = "auto", uva_overlap: bool = True,
@@ -251,9 +254,10 @@ class GraphSageSampler:
             mode = "TPU"
         if mode == "UVA" and uva_budget is None:
             mode = "TPU"  # whole graph fits the (unbounded) budget
-        assert dedup in ("none", "hop"), dedup
-        from .config import resolve_gather_mode, resolve_sample_rng
+        from .config import (resolve_dedup, resolve_gather_mode,
+                             resolve_sample_rng)
 
+        dedup = resolve_dedup(dedup)
         self.gather_mode = resolve_gather_mode(gather_mode, sample_rng)
         self.sample_rng = resolve_sample_rng(sample_rng, self.gather_mode)
         self.return_eid = return_eid
