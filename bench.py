@@ -391,23 +391,47 @@ def merge_tuned(updates: dict, backend: str, path=None):
     """MERGE measured winners into the tuned file — never whole-file
     rewrite: the gather probe and the dedup A/B run at different points
     of a window and each must not erase the other's key (or autotune's
-    sample_rng).  A file from another backend is discarded wholesale."""
+    sample_rng).  The file is per-backend ("backends" map, v2) so a CPU
+    rehearsal's probe can never delete TPU-measured evidence either;
+    legacy flat v1 files are upgraded in place."""
     tuned_path = _tuned_path(path)
-    payload = {}
+    backends = {}
     try:
         loaded = json.load(open(tuned_path))
-        if (isinstance(loaded, dict)
-                and loaded.get("backend") in (None, backend)):
-            payload = loaded
+        if isinstance(loaded, dict):
+            if isinstance(loaded.get("backends"), dict):
+                backends = loaded["backends"]
+            elif loaded.get("backend"):  # v1 flat: file under its tag
+                b1 = loaded.pop("backend")
+                backends = {b1: loaded}
     except Exception:
         pass
-    payload.update(updates, backend=backend)
+    entry = backends.get(backend)
+    if not isinstance(entry, dict):
+        entry = {}
+    entry.update(updates)
+    backends[backend] = entry
     try:
         with open(tuned_path, "w") as fh:
-            json.dump(payload, fh, indent=2)
+            json.dump({"backends": backends}, fh, indent=2)
     except Exception as e:  # pragma: no cover
         log(f"could not write tuned file: {e}")
-    return payload
+    return entry
+
+
+def read_tuned(backend: str, path=None) -> dict:
+    """This backend's tuned entry (v2 per-backend or legacy flat v1);
+    {} when absent/unreadable."""
+    try:
+        loaded = json.load(open(_tuned_path(path)))
+        if isinstance(loaded.get("backends"), dict):
+            entry = loaded["backends"].get(backend)
+            return entry if isinstance(entry, dict) else {}
+        if loaded.get("backend") == backend:
+            return loaded
+    except Exception:
+        pass
+    return {}
 
 
 def persist_dedup_winner(sections, backend, path=None):
@@ -420,7 +444,11 @@ def persist_dedup_winner(sections, backend, path=None):
     e2e = sections.get("e2e") or {}
     hop = sections.get("e2e_dedup_hop") or {}
     if (backend == "cpu" or "source" in e2e or "source" in hop
-            or not e2e.get("ms_per_step") or not hop.get("ms_per_step")):
+            or not e2e.get("ms_per_step") or not hop.get("ms_per_step")
+            # both halves must ride the SAME gather mode — a resumed run
+            # can pair a cached pwindow e2e with a fresh lanes hop and
+            # the comparison would be apples vs oranges
+            or e2e.get("gather_mode") != hop.get("gather_mode")):
         return None
     winner = "hop" if hop["ms_per_step"] < e2e["ms_per_step"] else "none"
     merge_tuned(
@@ -449,21 +477,14 @@ def pick_gather_mode(topo, batch_size, sizes, probe_timeout=420):
 
     import jax
 
-    tuned_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                              ".quiver_tpu_tuned.json")
-    if os.path.exists(tuned_path):
-        try:
-            tuned = json.load(open(tuned_path))
-            if (tuned.get("backend") == jax.default_backend()
-                    and tuned.get("gather_mode")
-                    # a tuned file from before the current mode set must
-                    # re-probe: round 3 added "blocked", which a pinned
-                    # "lanes" would otherwise shadow forever
-                    and tuned.get("modes_version") == GATHER_MODES_VERSION):
-                log(f"gather_mode={tuned['gather_mode']} (tuned file)")
-                return tuned["gather_mode"]
-        except Exception:
-            pass
+    tuned = read_tuned(jax.default_backend())
+    # a tuned file from before the current mode set must re-probe:
+    # round 3 added "blocked", which a pinned "lanes" would otherwise
+    # shadow forever
+    if (tuned.get("gather_mode")
+            and tuned.get("modes_version") == GATHER_MODES_VERSION):
+        log(f"gather_mode={tuned['gather_mode']} (tuned file)")
+        return tuned["gather_mode"]
 
     probe_b = min(256, batch_size)
     best_mode, best_dt = "xla", float("inf")

@@ -67,16 +67,26 @@ def _load_tuned(cfg: Config, path: Optional[str] = None):
         return
     try:
         tuned = json.load(open(path))
+        if not isinstance(tuned, dict):
+            return
     except Exception:
         return
     # only apply results probed on THIS backend (a cpu-probed choice must
-    # not override the TPU default and vice versa)
+    # not override the TPU default and vice versa).  v2 files keep one
+    # entry per backend under "backends" (bench.merge_tuned), so probing
+    # on one backend can never erase another's evidence; flat v1 files
+    # carry a single top-level "backend" tag.
     try:
         import jax
 
-        if tuned.get("backend") != jax.default_backend():
-            return
+        backend = jax.default_backend()
     except Exception:
+        return
+    if isinstance(tuned.get("backends"), dict):
+        tuned = tuned["backends"].get(backend)
+        if not isinstance(tuned, dict):
+            return
+    elif tuned.get("backend") != backend:
         return
     gm = tuned.get("gather_mode")
     # a malformed tuned value ("blocked:0", "blockedx") is ignored like

@@ -116,8 +116,18 @@ class DistGraphSampler:
         self.topo = topo
         self.mesh = mesh
         self.axis = axis
-        self.gather_mode = resolve_gather_mode(gather_mode, sample_rng)
-        self.sample_rng = resolve_sample_rng(sample_rng, self.gather_mode)
+        gm = resolve_gather_mode(gather_mode, sample_rng)
+        # rng resolves against the PRE-rewrite mode so auto still lands
+        # on "hash" under a pwindow pick — keeping the per-shard draws
+        # identical to the single-device pwindow stream
+        self.sample_rng = resolve_sample_rng(sample_rng, gm)
+        if gm.startswith("pwindow"):
+            # pallas_call outputs need explicit vma annotations under
+            # shard_map (jax >= 0.8 check_vma); until the kernel carries
+            # them, the per-shard local sampling rides the equivalent
+            # XLA blocked window mode — same windows, same draws
+            gm = "blocked" + gm[len("pwindow"):]
+        self.gather_mode = gm
         self.sizes = list(sizes)
         self.n = int(mesh.shape[axis])
         self.request_cap_frac = request_cap_frac

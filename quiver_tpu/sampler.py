@@ -257,6 +257,11 @@ class GraphSageSampler:
         from .config import (resolve_dedup, resolve_gather_mode,
                              resolve_sample_rng)
 
+        if mode == "UVA" and dedup == "auto":
+            # UVA's hot/cold split rides the positional pipeline only;
+            # a tuned/env 'hop' winner must not crash it (an EXPLICIT
+            # dedup="hop" still hits the assert below)
+            dedup = "none"
         dedup = resolve_dedup(dedup)
         self.gather_mode = resolve_gather_mode(gather_mode, sample_rng)
         self.sample_rng = resolve_sample_rng(sample_rng, self.gather_mode)

@@ -189,16 +189,39 @@ def test_persist_dedup_winner_gate(tmp_path, monkeypatch):
     assert bench.persist_dedup_winner(replay, "tpu", tuned) is None
     assert bench.persist_dedup_winner(live, "tpu", tuned) == "hop"
     import json
-    t = json.load(open(tuned))
-    assert t["dedup"] == "hop" and t["backend"] == "tpu"
+    assert bench.read_tuned("tpu", tuned)["dedup"] == "hop"
     live["e2e_dedup_hop"]["ms_per_step"] = 150.0
     assert bench.persist_dedup_winner(live, "tpu", tuned) == "none"
     # merge semantics: a later gather-probe write must keep the dedup key
     bench.merge_tuned({"gather_mode": "pwindow:3", "modes_version": 99},
                       "tpu", tuned)
-    t = json.load(open(tuned))
+    t = bench.read_tuned("tpu", tuned)
     assert t["dedup"] == "none" and t["gather_mode"] == "pwindow:3"
-    # other-backend file is discarded wholesale
+    # a CPU write must NOT erase the TPU entry (per-backend v2 format)
     bench.merge_tuned({"gather_mode": "lanes"}, "cpu", tuned)
-    t = json.load(open(tuned))
-    assert t == {"gather_mode": "lanes", "backend": "cpu"}
+    assert bench.read_tuned("cpu", tuned)["gather_mode"] == "lanes"
+    assert bench.read_tuned("tpu", tuned)["dedup"] == "none"
+    # a cross-mode A/B pair is refused
+    mixed = {"e2e": {"ms_per_step": 100.0, "gather_mode": "pwindow:3"},
+             "e2e_dedup_hop": {"ms_per_step": 80.0,
+                               "gather_mode": "lanes"}}
+    assert bench.persist_dedup_winner(mixed, "tpu", tuned) is None
+
+
+def test_uva_auto_dedup_survives_tuned_hop(monkeypatch, small_graph):
+    """A tuned/env dedup='hop' must not crash UVA samplers constructed
+    with the default dedup (UVA rides the positional pipeline only)."""
+    import numpy as np
+
+    from quiver_tpu import GraphSageSampler
+
+    monkeypatch.setenv("QUIVER_TPU_DEDUP", "hop")
+    qconfig._config = None
+    s = GraphSageSampler(small_graph, [3], mode="UVA",
+                         uva_budget=small_graph.edge_count * 2)
+    assert s.dedup == "none"
+    s.sample(np.arange(8, dtype=np.int32))
+    # an explicit hop still surfaces the incompatibility
+    with pytest.raises(AssertionError, match="positional"):
+        GraphSageSampler(small_graph, [3], mode="UVA", dedup="hop",
+                         uva_budget=small_graph.edge_count * 2)
