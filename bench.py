@@ -650,11 +650,32 @@ def bench_feature(n_nodes, dim, batch_rows, iters=20):
     dt = time.perf_counter() - t0
     out["cold_gbs"] = round(it2 * batch_rows * row_bytes / dt / 1e9, 2)
 
+    # ici_shard: hot prefix sharded over all visible devices (the
+    # p2p-clique-replicate analogue, reference 108.6 GB/s 2-GPU row);
+    # on a single chip this degenerates to hot — n_devices is recorded
+    # so the row is never misread as a multi-chip claim.  The mesh must
+    # be passed explicitly: without it Feature falls back to replicated
+    # placement and the row would silently re-measure hot_gbs.
+    from quiver_tpu import make_mesh
+
+    f_ici = Feature(device_cache_size=n_nodes, cache_unit="rows",
+                    cache_policy="ici_shard",
+                    mesh=make_mesh(("ici",))).from_cpu_tensor(feat)
+    f_ici[dev_ids[0]].block_until_ready()
+    t0 = time.perf_counter()
+    outs = [f_ici[dev_ids[2 + i]] for i in range(iters)]
+    outs[-1].block_until_ready()
+    dt = time.perf_counter() - t0
+    out["ici_shard_gbs"] = round(
+        iters * batch_rows * row_bytes / dt / 1e9, 2)
+    out["ici_n_devices"] = len(jax.devices())
+
     out["rows"] = batch_rows
     out["vs_baseline"] = round(out["budgeted20_gbs"] / BASELINE_FEATURE_GBS, 3)
     log(f"feature gather ({batch_rows:,} rows x {dim}): "
         f"hot {out['hot_gbs']} GB/s, 20%-budget {out['budgeted20_gbs']} "
-        f"GB/s, cold {out['cold_gbs']} GB/s")
+        f"GB/s, cold {out['cold_gbs']} GB/s, ici_shard "
+        f"{out['ici_shard_gbs']} GB/s x{out['ici_n_devices']}dev")
     return out
 
 

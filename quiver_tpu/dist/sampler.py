@@ -121,12 +121,16 @@ class DistGraphSampler:
         # on "hash" under a pwindow pick — keeping the per-shard draws
         # identical to the single-device pwindow stream
         self.sample_rng = resolve_sample_rng(sample_rng, gm)
+        # pallas_call outputs need explicit vma annotations under
+        # shard_map (jax >= 0.8 check_vma); until the kernels carry
+        # them, every pallas-backed mode degrades to its XLA equivalent
+        # for the per-shard local sampling: pwindow -> blocked (same
+        # windows, same draws), pallas/lanes_fused -> lanes (same
+        # row-gather + lane select, XLA-composed)
         if gm.startswith("pwindow"):
-            # pallas_call outputs need explicit vma annotations under
-            # shard_map (jax >= 0.8 check_vma); until the kernel carries
-            # them, the per-shard local sampling rides the equivalent
-            # XLA blocked window mode — same windows, same draws
             gm = "blocked" + gm[len("pwindow"):]
+        elif gm in ("pallas", "lanes_fused"):
+            gm = "lanes"
         self.gather_mode = gm
         self.sizes = list(sizes)
         self.n = int(mesh.shape[axis])

@@ -177,3 +177,10 @@ def test_dist_sampler_degrades_pwindow_to_blocked(small_graph):
     n_id, n_mask, num, blocks = s.sample(
         np.arange(16).reshape(8, 2) % small_graph.node_count, key=5)
     assert np.asarray(n_id).shape[0] == 8
+
+
+def test_dist_sampler_degrades_all_pallas_modes(small_graph):
+    mesh = make_mesh(("data",))
+    for gm, want in (("pallas", "lanes"), ("lanes_fused", "lanes")):
+        s = DistGraphSampler(small_graph, mesh, sizes=[3], gather_mode=gm)
+        assert s.gather_mode == want, (gm, s.gather_mode)
