@@ -988,13 +988,13 @@ def main():
     # e2e epoch + dedup A/B, serving lanes), and only then the 10-mode
     # probe + full sampling tail — a 15-min window must not die inside
     # probe subprocesses with feature/e2e/serving still unmeasured.
+    from quiver_tpu.config import resolve_gather_mode
+
     if "sampling" in want and not args.gather_mode and not args.small:
         # BANK a headline with the library default before everything
         # else.  If the probe later picks a different mode, the
         # invalidation loop below clears and re-measures; if it picks the
         # same mode (the measured default), this section is a cache hit.
-        from quiver_tpu.config import resolve_gather_mode
-
         gm0 = resolve_gather_mode("auto")
         runner.run(
             f"sampling_B{batches[0]}", 900,
@@ -1079,8 +1079,6 @@ def main():
     # judge has zero on-chip numbers for land before the probe can eat
     # the window.  If the probe later picks a different winner, the
     # post-probe pass below invalidates and re-measures them.
-    from quiver_tpu.config import resolve_gather_mode
-
     gm_default = args.gather_mode or resolve_gather_mode("auto")
     if "feature" in want:
         run_feature_sections()
@@ -1097,16 +1095,16 @@ def main():
             gm = pick_gather_mode(topo, batches[0], FANOUT)
 
         # one section per batch size, so a stall at B=2048 cannot discard
-        # a finished B=1024 measurement
-        invalidate_mode_mismatch(("sampling",), gm)
-        if gm != gm_default:
-            # post-probe pass: e2e/serving measured pre-probe under the
-            # default are stale the moment the probe disagrees
-            invalidate_mode_mismatch(("e2e", "serving"), gm)
-            if "e2e" in want:
-                run_e2e_sections(gm)
-            if "serving" in want:
-                run_serving_sections(gm)
+        # a finished B=1024 measurement.  e2e/serving are invalidated
+        # unconditionally against the probed winner — not only when it
+        # differs from TODAY'S default: a cached section from an older
+        # session can carry a third mode even when gm == gm_default —
+        # and re-run (pure cache hits when everything already matches).
+        invalidate_mode_mismatch(("sampling", "e2e", "serving"), gm)
+        if "e2e" in want:
+            run_e2e_sections(gm)
+        if "serving" in want:
+            run_serving_sections(gm)
         results = []
         for b in batches:
             r = runner.run(

@@ -24,8 +24,8 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-TUNED_PATH = os.path.join(os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__))), ".quiver_tpu_tuned.json")
+# single source for the tuned-file location: bench._tuned_path
+
 
 
 def main():
@@ -54,7 +54,9 @@ def main():
         print(f"{tag}: {ms:.1f} ms/batch")
         return ms
 
-    from bench import GATHER_MODES_VERSION, PROBE_MODES
+    from bench import GATHER_MODES_VERSION, PROBE_MODES, _tuned_path
+
+    tuned_path = _tuned_path()
 
     results = {gm: ms for gm in PROBE_MODES
                if (ms := probe(gm)) is not None}
@@ -86,8 +88,8 @@ def main():
     # A/B survives an autotune re-run
     from bench import merge_tuned
 
-    written = merge_tuned(payload, jax.default_backend(), TUNED_PATH)
-    print(f"tuned defaults -> {TUNED_PATH}: {written}")
+    written = merge_tuned(payload, jax.default_backend(), tuned_path)
+    print(f"tuned defaults -> {tuned_path}: {written}")
 
 
 if __name__ == "__main__":

@@ -44,11 +44,11 @@ from jax.experimental.pallas import tpu as pltpu
 __all__ = ["pallas_window_sample", "parse_pwindow"]
 
 from ..blockgather import DEFAULT_U, FALLBACK_FRAC
+from ..fastgather import LANES
 # the kernel body re-derives the XLA hash path with the SAME finalizer
 # and constants — imported, never copied, so they cannot diverge
 from ..sample import HASH_PHI, _fmix32
 
-LANES = 128
 SUB = 64      # seeds per stage = DMAs in flight per buffer
 STAGES = 4    # stages per grid program (static unroll)
 SPP = SUB * STAGES  # seeds per program
@@ -179,9 +179,9 @@ def pallas_window_sample(table2d: jax.Array, start: jax.Array,
         # fanout beyond one output row / table smaller than a window
         return classic()
 
-    kpad = max(8, -(-k // 8) * 8)
+    kpad = -(-k // 8) * 8  # next multiple of 8 (>= 8 for k >= 1)
     k0, k1 = _fold_key_words(key)
-    r0, fits, nfall, S, seed_of_slot, valid = _fit_split(
+    r0, _fits, nfall, S, seed_of_slot, valid = _fit_split(
         start, deg, U, B, fallback_frac)
     r0c = jnp.clip(r0, 0, R - U)
     off = start - (r0c << 7)
