@@ -1,5 +1,6 @@
 # Top-level convenience targets (parity: reference ./configure && make).
-.PHONY: all native test test-quick test-native asan bench smoke help
+.PHONY: all native test test-quick test-native asan bench smoke \
+	telemetry-check help
 
 all: native
 
@@ -24,5 +25,9 @@ smoke:
 test-quick:
 	python -m pytest tests/ -m "not slow" -q
 
+# telemetry suite + the no-HTTP-exporter-in-hot-paths guard
+telemetry-check:
+	python -m pytest tests/ -m telemetry -q
+
 help:
-	@echo "targets: native | test | test-quick | test-native | asan | bench | smoke"
+	@echo "targets: native | test | test-quick | test-native | asan | bench | smoke | telemetry-check"

@@ -229,7 +229,13 @@ class _SectionRunner:
 
     def run(self, name: str, seconds: int, fn):
         """Run ``fn`` under both bounds; return its result or the cached/
-        None one.  ``fn`` must return a JSON-serializable dict."""
+        None one.  ``fn`` must return a JSON-serializable dict.
+
+        Each fresh section also harvests the telemetry registry's
+        snapshot DELTA across the section into ``out["telemetry"]``
+        (compacted: histograms collapse to count/mean/p50/p99), so
+        every BENCH artifact carries per-stage counters and timing
+        breakdowns without any per-section wiring."""
         if name in self.state["sections"]:
             log(f"section {name}: reusing result from previous run")
             return self.state["sections"][name]
@@ -251,10 +257,21 @@ class _SectionRunner:
         t = threading.Timer(seconds + 60, hard_kill)
         t.daemon = True
         t.start()
+        try:
+            from quiver_tpu import telemetry as _tm
+
+            tel_before = _tm.snapshot() if _tm.enabled() else None
+        except Exception:
+            _tm, tel_before = None, None
         out = None  # _bounded suppresses section errors/timeouts
         try:
             with _bounded(name, seconds):
                 out = fn()
+            if (tel_before is not None and isinstance(out, dict)
+                    and "telemetry" not in out):
+                delta = _tm.snapshot_delta(tel_before, _tm.snapshot())
+                if delta:
+                    out["telemetry"] = _tm.summarize_snapshot(delta)
         finally:
             # rollback lives in the finally so an external SIGTERM (e.g.
             # the harvester's `timeout`) doesn't burn the attempt budget:
@@ -445,10 +462,12 @@ def persist_dedup_winner(sections, backend, path=None):
     hop = sections.get("e2e_dedup_hop") or {}
     if (backend == "cpu" or "source" in e2e or "source" in hop
             or not e2e.get("ms_per_step") or not hop.get("ms_per_step")
-            # both halves must ride the SAME gather mode — a resumed run
-            # can pair a cached pwindow e2e with a fresh lanes hop and
-            # the comparison would be apples vs oranges
-            or e2e.get("gather_mode") != hop.get("gather_mode")):
+            # both halves must ride the SAME, KNOWN gather mode — a
+            # resumed run can pair a cached pwindow e2e with a fresh
+            # lanes hop, and a legacy-format cache without the stamp
+            # must not slip through as None == None
+            or not e2e.get("gather_mode") or not hop.get("gather_mode")
+            or e2e["gather_mode"] != hop["gather_mode"]):
         return None
     winner = "hop" if hop["ms_per_step"] < e2e["ms_per_step"] else "none"
     merge_tuned(
@@ -905,11 +924,16 @@ def bench_serving(topo, dim, classes, n_requests=300, hidden=128,
         if hybrid is not None:
             hybrid.stop()
     st = server.stats()
+    breakdown = {
+        stage: round(v["mean_ms"], 3)
+        for stage, v in st.get("stage_breakdown_ms", {}).items()
+    }
     st = dict(p50_ms=round(st["p50_latency_ms"], 2),
               p99_ms=round(st["p99_latency_ms"], 2),
               rps=round(st["throughput_rps"], 1),
               count=st["count"], lane=mode,
-              gather_mode=sampler.gather_mode)
+              gather_mode=sampler.gather_mode,
+              stage_mean_ms=breakdown)
     if thr is not None:
         st["auto_threshold"] = round(thr, 1)
     log(f"serving[{mode}]: {n_requests} reqs in {wall:.2f}s -> "

@@ -115,7 +115,8 @@ def resolve_sample_rng(sample_rng: str,
     round 2): ``"hash"`` (counter-hash uniforms) on accelerators — the
     3-hop pipeline runs 50.8M SEPS with hash vs 34.6M threefry / 31.3M
     rbg — and ``"key"`` (key-based ``jax.random.uniform``) on CPU, where
-    threefry is fast and tests want reproducible streams.
+    threefry is fast and tests want reproducible streams.  PROVISIONAL:
+    measured at 100K-node scale; pending products-scale re-measurement.
 
     ``gather_mode`` (the RESOLVED mode, if the caller has one): the
     fused Pallas window kernel (``pwindow``) only supports the in-kernel
@@ -129,6 +130,20 @@ def resolve_sample_rng(sample_rng: str,
     if sample_rng != "auto":
         return sample_rng
     if gather_mode is not None and gather_mode.startswith("pwindow"):
+        cfg = get_config()
+        if cfg.sample_rng == "key":
+            # the pin came from QUIVER_TPU_SAMPLE_RNG / the tuned file,
+            # not an explicit kwarg (that returned above) — surface the
+            # override instead of silently ignoring the pin
+            import warnings
+
+            warnings.warn(
+                "sample_rng='key' pinned via env/tuned file is "
+                "overridden to 'hash': gather_mode='pwindow' fuses the "
+                "counter-hash RNG in-kernel. Pass sample_rng='key' "
+                "explicitly to get a hard error, or pick a "
+                "'blocked:U'/'lanes' gather mode to keep key-based "
+                "draws.", stacklevel=2)
         return "hash"
     cfg = get_config()
     if cfg.sample_rng != "auto":
@@ -196,7 +211,9 @@ def resolve_gather_mode(gather_mode: str,
     (row-gather + VPU lane select) on accelerators, where XLA's 1-D
     scalar gather serializes (docs/TPU_MEASUREMENTS.md round 2: 3-hop
     lanes 27 ms vs xla 237 ms per batch on v5e); plain ``"xla"`` take on
-    CPU.
+    CPU.  PROVISIONAL: those numbers come from a 100K-node graph — the
+    ranking is pending re-measurement at production scale (100M+ nodes,
+    where HBM pressure and table width change the gather trade-offs).
 
     ``sample_rng`` (the caller's RAW kwarg): when ``auto`` resolution
     lands on the Pallas ``pwindow`` kernel (hash-RNG-only) but the user

@@ -237,14 +237,25 @@ class DistFeature:
         valid = jax.device_put(valid, sharding)
         out, overflow = self._fn[key](self.shards, ids, valid)
         self.last_overflow = overflow
+        self._overflow_recorded = False
         return out
 
     def overflow_stats(self):
         """Per-host dropped-query counts from the most recent lookup as a
-        host int array (None before any call)."""
+        host int array (None before any call).  Materializing here also
+        feeds ``dist_feature_overflow_total`` — at query time, never in
+        the lookup hot path (that would force a device sync)."""
         if getattr(self, "last_overflow", None) is None:
             return None
-        return np.asarray(self.last_overflow)
+        arr = np.asarray(self.last_overflow)
+        if not getattr(self, "_overflow_recorded", True):
+            self._overflow_recorded = True
+            total = float(arr.sum())
+            if total:
+                from .. import telemetry
+
+                telemetry.counter("dist_feature_overflow_total").inc(total)
+        return arr
 
     def __getitem__(self, ids):
         ids = np.asarray(ids)

@@ -302,6 +302,7 @@ class DistGraphSampler:
             jnp.int32(key),
         )
         self.last_overflow = overflow
+        self._overflow_recorded = False
         return n_id, n_mask, num, blocks
 
     def overflow_stats(self):
@@ -309,7 +310,17 @@ class DistGraphSampler:
         call, as a host ``[n_shards, L]`` int array (None before any call).
         Parity note: the reference has no analogue — NCCL send/recv moves
         exact ragged sizes; fixed-capacity buckets are the TPU trade, so
-        the drop counter is the safety net."""
+        the drop counter is the safety net.  Materializing here also
+        feeds ``dist_sampler_overflow_total`` — at query time, never in
+        the sample hot path (that would force a device sync)."""
         if getattr(self, "last_overflow", None) is None:
             return None
-        return np.asarray(self.last_overflow)
+        arr = np.asarray(self.last_overflow)
+        if not getattr(self, "_overflow_recorded", True):
+            self._overflow_recorded = True
+            total = float(arr.sum())
+            if total:
+                from .. import telemetry
+
+                telemetry.counter("dist_sampler_overflow_total").inc(total)
+        return arr

@@ -235,13 +235,18 @@ class Feature:
         import jax
         import jax.numpy as jnp
 
-        from .utils.trace import trace_scope
+        from . import telemetry
 
         self.lazy_init_from_ipc_handle()
-        with trace_scope("feature.getitem"):
-            return self._getitem_impl(node_idx, jax, jnp)
+        tier = ("hot" if self.cache_count >= self.node_count else
+                ("cold" if self.cache_count == 0 else "mixed"))
+        with telemetry.span("feature.getitem"), telemetry.histogram(
+                "feature_gather_seconds", tier=tier).time():
+            out = self._getitem_impl(node_idx, jax, jnp, telemetry)
+        telemetry.counter("feature_gather_batches_total", tier=tier).inc()
+        return out
 
-    def _getitem_impl(self, node_idx, jax, jnp):
+    def _getitem_impl(self, node_idx, jax, jnp, telemetry):
         if self.cache_count >= self.node_count:
             if isinstance(node_idx, jax.Array):
                 return self.lookup_device(node_idx)
@@ -251,6 +256,10 @@ class Feature:
             return jnp.take(self.hot, jnp.asarray(idx), axis=0)
         idx = np.asarray(node_idx)
         staged = self._take_staged(idx.tobytes())
+        if self._plock is not None:
+            telemetry.counter(
+                "feature_prefetch_total",
+                result="hit" if staged is not None else "miss").inc()
         if staged is None:
             staged = self._stage(idx)
         hot_idx, bucket, cold_pos_d, cold_rows_d = staged
@@ -289,15 +298,26 @@ class Feature:
         """
         import jax.numpy as jnp
 
+        from . import telemetry
+
         if self.feature_order is not None:
             idx = self.feature_order[idx]
         idx = idx.astype(np.int64)
         if self.cache_count == 0:
+            telemetry.counter("feature_rows_total", tier="cold").inc(
+                float(len(idx)))
             return (None, -1, None,
                     jnp.asarray(np.ascontiguousarray(self.cold[idx])))
         hot_mask = idx < self.cache_count
         cold_pos = np.nonzero(~hot_mask)[0].astype(np.int32)
         n_cold = len(cold_pos)
+        # cache-hit accounting for the budgeted tier: a "hot" row is a
+        # cache hit served from HBM, a "cold" row crosses the host link
+        telemetry.counter("feature_rows_total", tier="hot").inc(
+            float(len(idx) - n_cold))
+        if n_cold:
+            telemetry.counter("feature_rows_total", tier="cold").inc(
+                float(n_cold))
         hot_idx = jnp.asarray(np.where(hot_mask, idx, 0).astype(np.int32))
         if n_cold == 0:
             return hot_idx, 0, None, None

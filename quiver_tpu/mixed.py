@@ -22,6 +22,7 @@ from typing import Generic, Iterator, List, Sequence, TypeVar
 
 import numpy as np
 
+from . import telemetry
 from .sampler import GraphSageSampler, SampledBatch
 from .utils.topology import CSRTopo
 
@@ -148,7 +149,11 @@ class MixedGraphSageSampler:
                 try:
                     t0 = time.perf_counter()
                     batch = self.cpu_sampler.sample(self.job[t])
-                    cpu_times.append(time.perf_counter() - t0)
+                    dt = time.perf_counter() - t0
+                    cpu_times.append(dt)
+                    telemetry.counter("mixed_tasks_total", lane="cpu").inc()
+                    telemetry.histogram("mixed_task_seconds",
+                                        lane="cpu").observe(dt)
                     results.put((batch, "cpu"))
                 except BaseException as e:  # surface to the consumer
                     results.put((e, "error"))
@@ -171,7 +176,11 @@ class MixedGraphSageSampler:
                 t0 = time.perf_counter()
                 batch = self.tpu_sampler.sample(self.job[t])
                 batch.n_id.block_until_ready()
-                tpu_times.append(time.perf_counter() - t0)
+                dt = time.perf_counter() - t0
+                tpu_times.append(dt)
+                telemetry.counter("mixed_tasks_total", lane="tpu").inc()
+                telemetry.histogram("mixed_task_seconds",
+                                    lane="tpu").observe(dt)
                 yield batch, "tpu"
                 produced += 1
                 while not results.empty():
@@ -192,5 +201,9 @@ class MixedGraphSageSampler:
                 th.join(timeout=5)
         if tpu_times:
             self.avg_tpu_time = float(np.mean(tpu_times))
+            telemetry.gauge("mixed_avg_task_seconds", lane="tpu").set(
+                self.avg_tpu_time)
         if cpu_times:
             self.avg_cpu_time = float(np.mean(cpu_times))
+            telemetry.gauge("mixed_avg_task_seconds", lane="cpu").set(
+                self.avg_cpu_time)

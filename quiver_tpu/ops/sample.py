@@ -226,6 +226,15 @@ def sample_neighbors(
         from .pallas.window_sample_kernel import (pallas_window_sample,
                                                   parse_pwindow)
 
+        backend = jax.default_backend()
+        if backend not in ("tpu", "cpu"):
+            # fail before Mosaic lowering produces an opaque XLA error —
+            # pwindow is TPU-only (CPU rides pallas interpret mode)
+            raise ValueError(
+                f"gather_mode='pwindow' needs backend 'tpu' (Mosaic "
+                f"kernel) or 'cpu' (interpret mode); running on "
+                f"{backend!r} — use the XLA 'blocked:U' window mode "
+                "there instead")
         assert indices.shape[0] % 128 == 0, (
             f"pwindow gather needs a 128-multiple indices table, got "
             f"{indices.shape[0]} — pad with ops.fastgather.pad_table_128"

@@ -28,6 +28,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from . import telemetry
 from .ops.sample import (sample_neighbors, sample_neighbors_weighted,
                          row_cumsum_weights)
 from .ops.reindex import reindex
@@ -368,7 +369,22 @@ class GraphSageSampler:
 
         Returns a :class:`SampledBatch`; call ``.to_pyg_adjs()`` for the
         reference's ``(n_id, batch_size, adjs)`` tuple.
+
+        Telemetry: each call folds into the ``sampler.sample`` span and
+        the ``sampler_sample_seconds{mode}`` histogram (TPU mode times
+        dispatch, not device completion — async), plus batch/seed
+        counters.
         """
+        mode = self.mode.lower()
+        with telemetry.span("sampler.sample"), telemetry.histogram(
+                "sampler_sample_seconds", mode=mode).time():
+            batch = self._sample_impl(input_nodes, key)
+        telemetry.counter("sampler_batches_total", mode=mode).inc()
+        telemetry.counter("sampler_seeds_total", mode=mode).inc(
+            float(batch.batch_size))
+        return batch
+
+    def _sample_impl(self, input_nodes, key=None) -> SampledBatch:
         if self.mode == "CPU":
             return self._sample_cpu(input_nodes)
         if self.mode == "UVA":
@@ -385,13 +401,13 @@ class GraphSageSampler:
             from .utils.rng import make_key
 
             key = make_key(np.random.randint(0, 2**31 - 1))
-        from .utils.trace import trace_scope
-
-        with trace_scope("sampler.sample"):
-            n_id, n_mask, num_nodes, blocks, drops = fn(seeds, key)
+        n_id, n_mask, num_nodes, blocks, drops = fn(seeds, key)
         # [L] per-hop frontier-cap drop counts (always 0 without caps);
-        # kept on device until someone asks via overflow_stats()
+        # kept on device until someone asks via overflow_stats() — the
+        # drop counter is incremented there, at materialization, so the
+        # hot loop never pays a device sync for accounting
         self.last_drops = drops
+        self._drops_recorded = False
         return SampledBatch(
             n_id=n_id, n_id_mask=n_mask, num_nodes=num_nodes,
             batch_size=B, layers=blocks, drops=drops,
@@ -411,7 +427,17 @@ class GraphSageSampler:
             return None if batch.drops is None else np.asarray(batch.drops)
         if getattr(self, "last_drops", None) is None:
             return None
-        return np.asarray(self.last_drops)
+        arr = np.asarray(self.last_drops)
+        # count into the registry exactly once per sample() call (the
+        # batch= form can't dedup across repeat queries, so only the
+        # sampler-level path feeds the counter)
+        if not getattr(self, "_drops_recorded", True):
+            self._drops_recorded = True
+            total = float(arr.sum())
+            if total:
+                telemetry.counter("sampler_frontier_drops_total",
+                                  mode=self.mode.lower()).inc(total)
+        return arr
 
     def _sample_uva(self, input_nodes, key) -> SampledBatch:
         """Hot/cold big-graph sampling (``quiver_tpu.uva``): HBM-budgeted

@@ -27,6 +27,8 @@ from typing import Callable, List, Optional, Sequence
 
 import numpy as np
 
+from . import telemetry
+
 __all__ = [
     "RequestBatcher", "HybridSampler", "InferenceServer",
     "InferenceServer_Debug", "ServingRequest", "calibrate_threshold",
@@ -232,7 +234,8 @@ class InferenceServer:
         return np.concatenate([ids, np.full(b - len(ids), ids[0] if len(ids)
                                             else 0, dtype=ids.dtype)])
 
-    def _run_bucketed(self, ids: np.ndarray) -> np.ndarray:
+    def _run_bucketed(self, ids: np.ndarray,
+                      stages: Optional[dict] = None) -> np.ndarray:
         """One padded device pass per <=top-bucket chunk.
 
         Requests above the top bucket are CHUNKED into top-bucket pieces so
@@ -240,6 +243,14 @@ class InferenceServer:
         an unbounded request size never triggers a fresh compile (the
         reference has no analogue: CUDA kernels take any shape; XLA
         executables don't).
+
+        ``stages``: optional dict accumulating per-stage wall seconds
+        (``sample`` / ``gather`` / ``infer``).  The stamps are
+        consecutive so the stage intervals partition this call's wall
+        time exactly; the final ``np.asarray`` host sync is charged to
+        ``infer`` (XLA dispatch is async — per-stage attribution of the
+        *device* time needs a profiler, not wall clocks).  Warmup passes
+        no dict and so never pollutes request metrics.
         """
         top = self.BUCKETS[-1]
         outs = []
@@ -248,12 +259,25 @@ class InferenceServer:
             chunk = ids[off: off + top]
             padded = self._pad_ids(chunk)
             if self._fused:
+                t0 = time.perf_counter()
                 out = self._fused_forward(padded)
+                outs.append(np.asarray(out)[: len(chunk)])
+                if stages is not None:  # one jit: stages are fused too
+                    stages["infer"] = (stages.get("infer", 0.0)
+                                       + time.perf_counter() - t0)
             else:
+                t0 = time.perf_counter()
                 batch = self.sampler.sample(padded)
+                t1 = time.perf_counter()
                 x = self.feature[np.asarray(batch.n_id)]
+                t2 = time.perf_counter()
                 out = self.apply_fn(self.params, x, batch.layers)
-            outs.append(np.asarray(out)[: len(chunk)])
+                outs.append(np.asarray(out)[: len(chunk)])  # sync point
+                t3 = time.perf_counter()
+                if stages is not None:
+                    stages["sample"] = stages.get("sample", 0.0) + t1 - t0
+                    stages["gather"] = stages.get("gather", 0.0) + t2 - t1
+                    stages["infer"] = stages.get("infer", 0.0) + t3 - t2
         return outs[0] if len(outs) == 1 else np.concatenate(outs)
 
     def _fused_forward(self, padded_ids: np.ndarray):
@@ -305,20 +329,18 @@ class InferenceServer:
         ids = np.asarray(req.ids)
         return self._run_bucketed(ids)[: len(ids)]
 
-    def _infer_presampled(self, req: ServingRequest, batch):
+    def _infer_presampled(self, req: ServingRequest, batch,
+                          stages: Optional[dict] = None):
+        t0 = time.perf_counter()
         x = self.feature[np.asarray(batch.n_id)]
+        t1 = time.perf_counter()
         out = self.apply_fn(self.params, x, batch.layers)
-        return np.asarray(out)[: len(req.ids)]
-
-    # -- loops ---------------------------------------------------------
-    # Unlike the reference's bare `while 1` loops (serving.py:198-230 —
-    # one bad request kills the worker process), a failed request is
-    # reported on the result queue and the lane keeps serving.
-    def _safe(self, req, fn, *args):
-        try:
-            self.result_queue.put((req, fn(*args)))
-        except Exception as e:  # noqa: BLE001 — lane must survive
-            self.result_queue.put((req, e))
+        out = np.asarray(out)[: len(req.ids)]  # sync point
+        t2 = time.perf_counter()
+        if stages is not None:
+            stages["gather"] = stages.get("gather", 0.0) + t1 - t0
+            stages["infer"] = stages.get("infer", 0.0) + t2 - t1
+        return out
 
     def _drain_coalesce(self, first: ServingRequest):
         """Pull queued requests (non-blocking) to batch one device pass —
@@ -341,9 +363,9 @@ class InferenceServer:
             budget -= len(item.ids)
         return reqs
 
-    def _infer_coalesced(self, reqs):
+    def _infer_coalesced(self, reqs, stages: Optional[dict] = None):
         ids = np.concatenate([np.asarray(r.ids) for r in reqs])
-        out = self._run_bucketed(ids)
+        out = self._run_bucketed(ids, stages)
         off = 0
         outs = []
         for r in reqs:
@@ -351,6 +373,10 @@ class InferenceServer:
             off += len(r.ids)
         return outs
 
+    # -- loops ---------------------------------------------------------
+    # Unlike the reference's bare `while 1` loops (serving.py:198-230 —
+    # one bad request kills the worker process), a failed request is
+    # reported on the result queue and the lane keeps serving.
     def _device_loop(self):
         while not self._stopped.is_set():
             item = self.device_q.get()
@@ -360,24 +386,81 @@ class InferenceServer:
                 self._drain_coalesce(item) if self.max_coalesce > 1
                 else [item]
             )
+            # dequeue stamp AFTER coalescing: queue_wait covers time on
+            # the queue plus the drain, so the per-request intervals
+            # (queue_wait + stages) still partition end-to-end latency
+            t_deq = time.perf_counter()
+            stages: dict = {}
             try:
-                outs = self._infer_coalesced(reqs)
+                outs = self._infer_coalesced(reqs, stages)
+                t_done = time.perf_counter()
                 for r, o in zip(reqs, outs):
-                    self._finish(r, o)
+                    self._finish(r, o, lane="device", stages=stages,
+                                 t_dequeue=t_deq, t_done=t_done)
             except Exception as e:  # noqa: BLE001 — lane must survive
                 for r in reqs:
+                    telemetry.counter("serving_requests_total",
+                                      lane="device", status="error").inc()
                     self.result_queue.put((r, e))
-
-    def _finish(self, req, out):
-        self.result_queue.put((req, out))
 
     def _cpu_loop(self):
         while not self._stopped.is_set():
             item = self.cpu_q.get()
             if item is _STOP:
                 break
-            req, batch, _ = item
-            self._safe(req, self._infer_presampled, req, batch)
+            req, batch, sample_dt = item
+            stages = {"sample": float(sample_dt)}
+            try:
+                out = self._infer_presampled(req, batch, stages)
+                t_done = time.perf_counter()
+                self._finish(req, out, lane="cpu", stages=stages,
+                             t_done=t_done)
+            except Exception as e:  # noqa: BLE001 — lane must survive
+                telemetry.counter("serving_requests_total",
+                                  lane="cpu", status="error").inc()
+                self.result_queue.put((req, e))
+
+    def _finish(self, req, out, lane: str = "device",
+                stages: Optional[dict] = None,
+                t_dequeue: Optional[float] = None,
+                t_done: Optional[float] = None):
+        self._record_request(req, lane, stages or {}, t_dequeue, t_done)
+        self.result_queue.put((req, out))
+
+    def _record_request(self, req, lane, stages, t_dequeue, t_done):
+        """Fold one served request into the registry.  Returns
+        ``(e2e_seconds, full_stage_dict)`` so the Debug subclass can
+        reuse the exact same numbers for its local accounting.
+
+        ``queue_wait`` is the dequeue stamp minus the enqueue stamp when
+        the lane observed one (device lane), else the residual of the
+        measured stages against end-to-end (CPU lane, whose ``sample``
+        happened inside HybridSampler before this server saw the item).
+        Either way ``sum(stages) ≈ e2e``.
+        """
+        now = t_done if t_done is not None else time.perf_counter()
+        e2e = max(now - req.t_enqueue, 0.0)
+        full = dict(stages)
+        if t_dequeue is not None:
+            full["queue_wait"] = max(t_dequeue - req.t_enqueue, 0.0)
+        else:
+            full["queue_wait"] = max(e2e - sum(full.values()), 0.0)
+        telemetry.counter("serving_requests_total", lane=lane,
+                          status="ok").inc()
+        telemetry.histogram("serving_request_seconds", lane=lane).observe(e2e)
+        for stage, dt in full.items():
+            telemetry.histogram("serving_stage_seconds", lane=lane,
+                                stage=stage).observe(dt)
+        return e2e, full
+
+    def expose_metrics(self, port: int = 0, host: str = "127.0.0.1"):
+        """Start the stdlib HTTP metrics endpoint (/metrics,
+        /metrics.json, /trace.json) for this process' registry.  Lazy
+        import: serving has no hard dependency on the exporter."""
+        from .telemetry.export import start_http_server
+
+        self._metrics_server = start_http_server(port=port, host=host)
+        return self._metrics_server
 
     def start(self):
         t = threading.Thread(target=self._device_loop, daemon=True)
@@ -396,6 +479,10 @@ class InferenceServer:
             self.cpu_q.put(_STOP)
         for t in self._threads:
             t.join(timeout=10)
+        srv = getattr(self, "_metrics_server", None)
+        if srv is not None:
+            srv.close()
+            self._metrics_server = None
 
 
 def calibrate_threshold(tpu_sampler, cpu_sampler, feature, apply_fn, params,
@@ -471,47 +558,55 @@ def _fit_crossover(points) -> float:
 class InferenceServer_Debug(InferenceServer):
     """Latency-instrumented server (parity: serving.py:298-360).
 
-    Records per-request end-to-end latency; ``stats()`` returns
-    avg / p50 / p99 latency and throughput, the reference's tp99 harness.
+    ``stats()`` returns avg / p50 / p99 latency and throughput (the
+    reference's tp99 harness) plus ``stage_breakdown_ms`` — per-stage
+    (queue_wait / sample / gather / infer) mean and total.  Accounting
+    lives on a private fixed-bucket :class:`~quiver_tpu.telemetry.Histogram`
+    rather than the old unbounded per-request list: memory is O(buckets)
+    under sustained traffic, p50/p99 read from bucket interpolation
+    (~13% worst-case with the default ~1.26x grid), and the same numbers
+    flow into the process registry via the base class.
     """
 
     def __init__(self, *args, **kwargs):
         super().__init__(*args, **kwargs)
-        self.latencies: List[float] = []
+        self._hist = telemetry.Histogram("serving_debug_latency")
+        self._stage_acc: dict = {}  # stage -> [count, total_s]
         self._t_first = None
         self._t_last = None
         self._count = 0
         self._lock = threading.Lock()
 
-    def _record(self, req: ServingRequest):
-        now = time.perf_counter()
+    def _record_request(self, req, lane, stages, t_dequeue, t_done):
+        e2e, full = super()._record_request(req, lane, stages, t_dequeue,
+                                            t_done)
+        self._hist.observe(e2e)
         with self._lock:
-            self.latencies.append(now - req.t_enqueue)
             self._t_first = self._t_first or req.t_enqueue
-            self._t_last = now
+            self._t_last = req.t_enqueue + e2e
             self._count += 1
-
-    def _safe(self, req, fn, *args):
-        try:
-            out = fn(*args)
-            self._record(req)
-            self.result_queue.put((req, out))
-        except Exception as e:  # noqa: BLE001
-            self.result_queue.put((req, e))
-
-    def _finish(self, req, out):
-        self._record(req)
-        self.result_queue.put((req, out))
+            for stage, dt in full.items():
+                acc = self._stage_acc.setdefault(stage, [0, 0.0])
+                acc[0] += 1
+                acc[1] += dt
+        return e2e, full
 
     def stats(self) -> dict:
-        lat = np.asarray(sorted(self.latencies))
-        if len(lat) == 0:
-            return dict(count=0)
-        span = max((self._t_last or 0) - (self._t_first or 0), 1e-9)
+        with self._lock:
+            n = self._count
+            if n == 0:
+                return dict(count=0)
+            span = max((self._t_last or 0) - (self._t_first or 0), 1e-9)
+            breakdown = {
+                stage: dict(mean_ms=float(t / c * 1e3),
+                            total_ms=float(t * 1e3))
+                for stage, (c, t) in sorted(self._stage_acc.items())
+            }
         return dict(
-            count=int(self._count),
-            avg_latency_ms=float(lat.mean() * 1e3),
-            p50_latency_ms=float(np.percentile(lat, 50) * 1e3),
-            p99_latency_ms=float(np.percentile(lat, 99) * 1e3),
-            throughput_rps=float(self._count / span),
+            count=int(n),
+            avg_latency_ms=float(self._hist.mean * 1e3),
+            p50_latency_ms=float(self._hist.percentile(50) * 1e3),
+            p99_latency_ms=float(self._hist.percentile(99) * 1e3),
+            throughput_rps=float(n / span),
+            stage_breakdown_ms=breakdown,
         )

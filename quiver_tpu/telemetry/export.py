@@ -1,0 +1,154 @@
+"""Exposition formats + optional HTTP endpoint.
+
+Kept OUT of the hot path on purpose: nothing under ``quiver_tpu``
+imports this module at import time (a guard test pins that), so the
+stdlib ``http.server`` dependency only loads when someone actually
+calls ``InferenceServer.expose_metrics()`` / ``start_http_server()``.
+
+Three views:
+
+  * ``to_prometheus_text(snapshot)`` — Prometheus exposition format
+    (counters, gauges, and cumulative ``_bucket{le=...}`` histograms).
+  * ``to_json(snapshot)`` — the snapshot itself, serialized.
+  * ``start_http_server()`` — a daemon-threaded stdlib server exposing
+    ``/metrics`` (text), ``/metrics.json``, and ``/trace.json`` (Chrome
+    trace events, Perfetto-loadable).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from .registry import parse_metric_key
+
+__all__ = ["to_prometheus_text", "to_json", "MetricsServer",
+           "start_http_server"]
+
+
+def _fmt_labels(labels: dict, extra: Optional[dict] = None) -> str:
+    merged = dict(labels)
+    if extra:
+        merged.update(extra)
+    if not merged:
+        return ""
+    inner = ",".join(f'{k}="{merged[k]}"' for k in sorted(merged))
+    return "{" + inner + "}"
+
+
+def _fmt_num(v: float) -> str:
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(float(v))
+
+
+def to_prometheus_text(snapshot: dict) -> str:
+    """Prometheus text exposition of a registry snapshot."""
+    lines = []
+    typed = set()
+
+    def _type(name: str, kind: str) -> None:
+        if name not in typed:
+            typed.add(name)
+            lines.append(f"# TYPE {name} {kind}")
+
+    for key, v in sorted(snapshot.get("counters", {}).items()):
+        name, labels = parse_metric_key(key)
+        _type(name, "counter")
+        lines.append(f"{name}{_fmt_labels(labels)} {_fmt_num(v)}")
+    for key, v in sorted(snapshot.get("gauges", {}).items()):
+        name, labels = parse_metric_key(key)
+        _type(name, "gauge")
+        lines.append(f"{name}{_fmt_labels(labels)} {_fmt_num(v)}")
+    for key, d in sorted(snapshot.get("histograms", {}).items()):
+        name, labels = parse_metric_key(key)
+        _type(name, "histogram")
+        cum = 0
+        for bound, c in zip(d["bounds"], d["counts"]):
+            cum += c
+            lines.append(f"{name}_bucket"
+                         f"{_fmt_labels(labels, {'le': _fmt_num(bound)})} "
+                         f"{cum}")
+        cum += d["counts"][-1]
+        lines.append(
+            f"{name}_bucket{_fmt_labels(labels, {'le': '+Inf'})} {cum}")
+        lines.append(f"{name}_sum{_fmt_labels(labels)} {_fmt_num(d['sum'])}")
+        lines.append(f"{name}_count{_fmt_labels(labels)} {cum}")
+    return "\n".join(lines) + "\n"
+
+
+def to_json(snapshot: dict, indent: Optional[int] = None) -> str:
+    return json.dumps(snapshot, indent=indent, sort_keys=True)
+
+
+class MetricsServer:
+    """Daemon-threaded stdlib HTTP server over a registry + tracer."""
+
+    def __init__(self, registry=None, tracer=None, host: str = "127.0.0.1",
+                 port: int = 0):
+        if registry is None or tracer is None:
+            from . import get_registry, get_tracer
+            registry = registry or get_registry()
+            tracer = tracer or get_tracer()
+        self.registry = registry
+        self.tracer = tracer
+        outer = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 (stdlib API name)
+                try:
+                    if self.path.startswith("/metrics.json"):
+                        body = to_json(outer.registry.snapshot(), indent=2)
+                        ctype = "application/json"
+                    elif self.path.startswith("/metrics"):
+                        body = to_prometheus_text(outer.registry.snapshot())
+                        ctype = "text/plain; version=0.0.4"
+                    elif self.path.startswith("/trace.json"):
+                        body = json.dumps(outer.tracer.chrome_trace())
+                        ctype = "application/json"
+                    else:
+                        self.send_error(404)
+                        return
+                except Exception as e:  # pragma: no cover - defensive
+                    self.send_error(500, str(e))
+                    return
+                data = body.encode()
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def log_message(self, *a):  # silence per-request stderr spam
+                pass
+
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name="quiver-metrics-http",
+                                        daemon=True)
+        self._thread.start()
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    @property
+    def url(self) -> str:
+        host, port = self._httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+
+
+def start_http_server(port: int = 0, host: str = "127.0.0.1",
+                      registry=None, tracer=None) -> MetricsServer:
+    """Start the metrics endpoint; ``port=0`` picks a free port (read it
+    back from ``server.port``)."""
+    return MetricsServer(registry=registry, tracer=tracer, host=host,
+                         port=port)

@@ -1,31 +1,38 @@
-"""Tracing / timing / debug utilities.
+"""Tracing / timing / debug utilities — back-compat shim.
 
 Reference parity: ``TRACE_SCOPE`` compile-time macros (``trace.hpp:6-13``,
 enabled by ``QUIVER_ENABLE_TRACE``), the RAII ``timer`` (``timer.hpp:7-30``)
 and ``show_tensor_info`` (``srcs/cpp/src/quiver/cpu/tensor.cpp:96``).
 
-TPU-native version: spans are env-gated (``QUIVER_TPU_TRACE=1``) python
-context managers that aggregate wall time per scope name (device work is
-async — spans around jitted calls measure dispatch unless you pass
-``block=True``), plus an optional bridge into ``jax.profiler`` traces for
-XLA-level timelines.
+The span machinery itself now lives in :mod:`quiver_tpu.telemetry.spans`;
+this module keeps the historical API (``trace_scope`` / ``Timer`` /
+``trace_summary`` / ``reset_trace``, env-gated by ``QUIVER_TPU_TRACE=1``)
+and delegates to the process-wide :class:`~quiver_tpu.telemetry.SpanTracer`
+so old call sites and the new instrumentation aggregate into ONE place.
+Device work is async — spans around jitted calls measure dispatch unless
+you pass ``block=`` an array (or list of arrays) to block on.
 """
 
 from __future__ import annotations
 
 import contextlib
 import os
-import threading
 import time
-from collections import defaultdict
 from typing import Dict
+
+from .. import telemetry as _telemetry
 
 __all__ = ["trace_scope", "Timer", "trace_summary", "reset_trace",
            "show_tensor_info", "profile_trace"]
 
 _ENABLED = os.environ.get("QUIVER_TPU_TRACE", "0") not in ("0", "", "false")
-_stats = defaultdict(lambda: [0, 0.0])  # name -> [count, total_s]
-_lock = threading.Lock()
+
+
+def _tracer():
+    # the REAL tracer, not the noop: this module has its own gate
+    # (QUIVER_TPU_TRACE) predating the QUIVER_TELEMETRY switch, and its
+    # tested contract is "set_enabled(True) => spans aggregate".
+    return _telemetry._tracer
 
 
 def enabled() -> bool:
@@ -35,31 +42,21 @@ def enabled() -> bool:
 def set_enabled(on: bool):
     global _ENABLED
     _ENABLED = on
+    # QUIVER_TPU_TRACE historically meant "record spans"; in the new
+    # subsystem that maps to Chrome-trace event retention as well.
+    _tracer().set_tracing(bool(on))
 
 
-@contextlib.contextmanager
 def trace_scope(name: str, block=None):
     """Aggregate wall-time span (parity: ``TRACE_SCOPE(name)``).
 
-    ``block``: optional array/pytree to ``jax.block_until_ready`` on exit so
-    the span covers device execution, not just dispatch.
+    ``block``: optional array (or list/tuple of arrays) to
+    ``block_until_ready`` on exit so the span covers device execution,
+    not just dispatch.
     """
     if not _ENABLED:
-        yield
-        return
-    t0 = time.perf_counter()
-    try:
-        yield
-    finally:
-        if block is not None:
-            import jax
-
-            jax.block_until_ready(block)
-        dt = time.perf_counter() - t0
-        with _lock:
-            s = _stats[name]
-            s[0] += 1
-            s[1] += dt
+        return contextlib.nullcontext()
+    return _tracer().span(name, block=block)
 
 
 class Timer:
@@ -81,17 +78,11 @@ class Timer:
 
 def trace_summary() -> Dict[str, Dict[str, float]]:
     """Per-scope {count, total_s, mean_ms}."""
-    with _lock:
-        return {
-            k: dict(count=v[0], total_s=v[1],
-                    mean_ms=v[1] / max(v[0], 1) * 1e3)
-            for k, v in _stats.items()
-        }
+    return _tracer().summary()
 
 
 def reset_trace():
-    with _lock:
-        _stats.clear()
+    _tracer().reset()
 
 
 @contextlib.contextmanager
@@ -108,8 +99,6 @@ def profile_trace(log_dir: str):
 
 def show_tensor_info(t, name: str = "tensor", printer=print):
     """Shape/dtype/device printer (parity: N15 ``show_tensor_info``)."""
-    import numpy as np
-
     try:
         devs = getattr(t, "devices", None)
         dev = list(devs()) if callable(devs) else None

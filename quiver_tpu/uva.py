@@ -124,6 +124,7 @@ def sample_uva(uva: UVAGraph, sizes, input_nodes, key, gather_mode="xla",
     import jax
     import jax.numpy as jnp
 
+    from . import telemetry
     from .ops.sample import sample_neighbors
 
     frontier = np.asarray(input_nodes, dtype=np.int32)
@@ -150,9 +151,16 @@ def sample_uva(uva: UVAGraph, sizes, input_nodes, key, gather_mode="xla",
             t0 = _time.perf_counter()
             cn, cm, _ = uva.cpu.sample_neighbors(frontier[cold_idx], k,
                                                  seed=hop_seed)
+            host_dt = _time.perf_counter() - t0
             if timings is not None:
-                timings["host_s"] = (timings.get("host_s", 0.0)
-                                     + _time.perf_counter() - t0)
+                timings["host_s"] = timings.get("host_s", 0.0) + host_dt
+            telemetry.histogram("uva_host_tier_seconds").observe(host_dt)
+        # per-hop hot/cold seed attribution: how much of the frontier the
+        # HBM sub-CSR actually covered (the UVA design bet)
+        telemetry.counter("uva_seeds_total", tier="hot").inc(
+            float(hot.sum()))
+        telemetry.counter("uva_seeds_total", tier="cold").inc(
+            float(len(cold_idx)))
         nbrs = np.asarray(out.nbrs).copy()   # sync point
         mask = np.asarray(out.mask).copy()
         if len(cold_idx):

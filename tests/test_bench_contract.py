@@ -108,6 +108,38 @@ class TestSectionRunnerPersistence:
         assert r.run("e2e", 30, lambda: {"ok": 1}) == {"ok": 1}
 
 
+class TestServingSetupCache:
+    """_serving_setup's cache must not key on id(topo) alone: a collected
+    topo's address can be recycled by a NEW same-shape graph and serve a
+    stale sampler/feature pair (round-5 advisor carry-over)."""
+
+    def _topo(self, seed):
+        import numpy as np
+
+        from quiver_tpu.utils.topology import CSRTopo
+
+        rng = np.random.default_rng(seed)
+        src = rng.integers(0, 40, 300)
+        dst = rng.integers(0, 40, 300)
+        return CSRTopo(edge_index=np.stack([src, dst]))
+
+    def test_hit_same_topo_miss_fresh_topo_and_strong_ref(self, monkeypatch):
+        monkeypatch.setattr(bench, "_SERVING_CACHE", {})
+        t1 = self._topo(0)
+        v1 = bench._serving_setup(t1, dim=4, classes=2, hidden=4)
+        assert bench._serving_setup(t1, 4, 2, 4) is v1  # cache hit
+        # the cache pins the keyed topo alive so its id cannot be reused
+        assert bench._SERVING_CACHE["topo"] is t1
+        # a different graph object never reuses the entry, even when the
+        # node/edge counts happen to collide
+        t2 = self._topo(1)
+        assert (t2.node_count, t2.edge_count) == (t1.node_count,
+                                                  t1.edge_count)
+        v2 = bench._serving_setup(t2, 4, 2, 4)
+        assert v2 is not v1
+        assert bench._SERVING_CACHE["topo"] is t2
+
+
 class TestHarvestGate:
     """bench.is_live_harvest — the ONE gate shared by the retry loop's
     validity check and harvest_commit.py."""

@@ -130,6 +130,44 @@ def test_auto_rng_resolves_hash_under_pwindow(monkeypatch):
     assert resolve_sample_rng("auto", "lanes") == "key"
 
 
+def test_env_pinned_key_rng_warns_under_pwindow(monkeypatch):
+    """gather_mode='pwindow' forces 'hash'; when the displaced 'key' pin
+    came from env/tuned (not an explicit kwarg) the override must be
+    surfaced as a warning, not silent."""
+    import warnings
+
+    monkeypatch.setenv("QUIVER_TPU_SAMPLE_RNG", "key")
+    qconfig._config = None
+    with pytest.warns(UserWarning, match="overridden to 'hash'"):
+        assert resolve_sample_rng("auto", "pwindow:2") == "hash"
+    # no pin -> no warning (the override changes nothing the user chose)
+    monkeypatch.delenv("QUIVER_TPU_SAMPLE_RNG")
+    qconfig._config = None
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        assert resolve_sample_rng("auto", "pwindow:2") == "hash"
+
+
+def test_pwindow_rejects_unsupported_backend(monkeypatch, small_graph):
+    """An unsupported backend must fail with a clear ValueError before
+    Mosaic lowering is attempted (ops/sample.py pwindow branch)."""
+    import jax
+
+    from quiver_tpu.ops.fastgather import pad_table_128
+    from quiver_tpu.ops.sample import sample_neighbors
+    from quiver_tpu.utils.rng import make_key
+
+    indptr, indices = small_graph.to_device()
+    indices = pad_table_128(indices)
+    monkeypatch.setattr(jax, "default_backend", lambda: "gpu")
+    with pytest.raises(ValueError, match="pwindow.*needs backend"):
+        # unique k so the jit cache can't serve a pre-gate trace
+        sample_neighbors(indptr, indices,
+                         jax.numpy.arange(13, dtype=jax.numpy.int32),
+                         7, make_key(0), gather_mode="pwindow:2",
+                         sample_rng="hash")
+
+
 def test_auto_gather_degrades_pwindow_for_explicit_key_rng(monkeypatch):
     """A tuned/env 'pwindow' pick must not crash a user who explicitly
     chose sample_rng='key': auto resolution degrades to the equivalent
@@ -181,10 +219,12 @@ def test_persist_dedup_winner_gate(tmp_path, monkeypatch):
     import bench
 
     tuned = str(tmp_path / "tuned.json")
-    live = {"e2e": {"ms_per_step": 100.0},
-            "e2e_dedup_hop": {"ms_per_step": 80.0}}
-    replay = {"e2e": {"ms_per_step": 100.0, "source": "cached:tpu"},
-              "e2e_dedup_hop": {"ms_per_step": 80.0}}
+    live = {"e2e": {"ms_per_step": 100.0, "gather_mode": "lanes"},
+            "e2e_dedup_hop": {"ms_per_step": 80.0, "gather_mode": "lanes"}}
+    replay = {"e2e": {"ms_per_step": 100.0, "source": "cached:tpu",
+                      "gather_mode": "lanes"},
+              "e2e_dedup_hop": {"ms_per_step": 80.0,
+                                "gather_mode": "lanes"}}
     assert bench.persist_dedup_winner(live, "cpu", tuned) is None
     assert bench.persist_dedup_winner(replay, "tpu", tuned) is None
     assert bench.persist_dedup_winner(live, "tpu", tuned) == "hop"
@@ -206,6 +246,15 @@ def test_persist_dedup_winner_gate(tmp_path, monkeypatch):
              "e2e_dedup_hop": {"ms_per_step": 80.0,
                                "gather_mode": "lanes"}}
     assert bench.persist_dedup_winner(mixed, "tpu", tuned) is None
+    # legacy-format caches WITHOUT the gather_mode stamp are refused too:
+    # None == None must not pass as "same mode" (missing on either side
+    # or both means the pair's comparability is unknown)
+    legacy = {"e2e": {"ms_per_step": 100.0},
+              "e2e_dedup_hop": {"ms_per_step": 80.0}}
+    assert bench.persist_dedup_winner(legacy, "tpu", tuned) is None
+    half = {"e2e": {"ms_per_step": 100.0, "gather_mode": "lanes"},
+            "e2e_dedup_hop": {"ms_per_step": 80.0}}
+    assert bench.persist_dedup_winner(half, "tpu", tuned) is None
 
 
 def test_uva_auto_dedup_survives_tuned_hop(monkeypatch, small_graph):
