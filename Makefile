@@ -1,6 +1,6 @@
 # Top-level convenience targets (parity: reference ./configure && make).
 .PHONY: all native test test-quick test-native asan bench smoke \
-	telemetry-check help
+	telemetry-check lint help
 
 all: native
 
@@ -29,5 +29,9 @@ test-quick:
 telemetry-check:
 	python -m pytest tests/ -m telemetry -q
 
+# quiverlint: hot-path static analysis (docs/STATIC_ANALYSIS.md)
+lint:
+	python -m quiver_tpu.analysis quiver_tpu bench.py
+
 help:
-	@echo "targets: native | test | test-quick | test-native | asan | bench | smoke | telemetry-check"
+	@echo "targets: native | test | test-quick | test-native | asan | bench | smoke | telemetry-check | lint"

@@ -815,9 +815,10 @@ def _serving_setup(topo, dim, classes, hidden, gather_mode="auto"):
     b0 = sampler.sample(np.arange(8, dtype=np.int32))
     x0 = feature[np.asarray(b0.n_id)]
     params = model.init(_mk(0), x0, b0.layers)
-    apply_fn = jax.jit(
-        lambda p, x, blocks: model.apply(p, x, blocks, train=False)
-    )
+    def _apply_eval(p, x, blocks):
+        return model.apply(p, x, blocks, train=False)
+
+    apply_fn = jax.jit(_apply_eval)
     val = dict(sampler=sampler, feature=feature, params=params,
                apply_fn=apply_fn, n=n, cpu=None)
     _SERVING_CACHE.update(key=key, val=val, topo=topo)

@@ -1,0 +1,34 @@
+"""quiverlint — static analysis for the TPU hot-path contract.
+
+Rule catalogue (see ``docs/STATIC_ANALYSIS.md`` for the full write-up):
+
+  QT001  host-sync-in-hot-path   device_get / block_until_ready / host
+                                 casts of device values in hot modules
+  QT002  retrace-hazard          jit patterns that defeat the executable
+                                 cache (fresh lambdas, jit in loops,
+                                 shape-affecting traced params, mutable
+                                 self capture)
+  QT003  lock-discipline         _guarded_by-declared attributes mutated
+                                 outside their lock
+  QT004  import-layering         import-time dependency on the telemetry
+                                 HTTP exporter from library modules
+  QT005  library-hygiene         mutable default args, bare except:
+
+Programmatic use::
+
+    from quiver_tpu.analysis import analyze_paths, LintConfig
+    result = analyze_paths(["quiver_tpu"], root=repo_root)
+
+Runtime companion: :mod:`quiver_tpu.analysis.retrace_guard` is a pytest
+plugin enforcing ``@pytest.mark.retrace_budget(n)`` (it is NOT imported
+here — it needs pytest, and the linter must stay stdlib-only).
+"""
+
+from .baseline import DEFAULT_BASELINE_NAME
+from .core import Finding, LintConfig, LintResult, analyze_paths
+from .rules import RULE_CLASSES, all_rules
+
+__all__ = [
+    "Finding", "LintConfig", "LintResult", "analyze_paths",
+    "all_rules", "RULE_CLASSES", "DEFAULT_BASELINE_NAME",
+]

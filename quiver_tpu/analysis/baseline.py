@@ -1,0 +1,69 @@
+"""quiverlint baseline — accepted pre-existing findings, committed to git.
+
+The baseline is a multiset of finding fingerprints ``(rule, path, scope,
+snippet)``.  Line numbers are deliberately excluded so edits elsewhere
+in a file don't churn the baseline; moving or editing the flagged line
+itself *does* invalidate the entry, which is the behavior you want — a
+touched finding must be re-justified (fix it, suppress it inline, or
+re-record the baseline).
+
+Workflow::
+
+    python -m quiver_tpu.analysis quiver_tpu bench.py --write-baseline
+    git add quiverlint.baseline.json
+
+CI then runs the linter normally: findings matching the baseline are
+reported as "baselined" and don't affect the exit code; anything new
+fails the run (see ``tests/test_lint_clean.py``).
+"""
+
+from __future__ import annotations
+
+import json
+from collections import Counter
+from pathlib import Path
+from typing import List, Sequence, Tuple
+
+from .core import Finding
+
+__all__ = ["DEFAULT_BASELINE_NAME", "load", "save", "partition"]
+
+DEFAULT_BASELINE_NAME = "quiverlint.baseline.json"
+_VERSION = 1
+
+
+def save(path, findings: Sequence[Finding]) -> None:
+    doc = {
+        "version": _VERSION,
+        "tool": "quiverlint",
+        "findings": [f.to_dict() for f in sorted(
+            findings, key=lambda x: (x.path, x.line, x.rule))],
+    }
+    Path(path).write_text(json.dumps(doc, indent=2) + "\n")
+
+
+def load(path) -> List[Finding]:
+    doc = json.loads(Path(path).read_text())
+    if doc.get("version") != _VERSION:
+        raise ValueError(
+            f"baseline {path}: unsupported version {doc.get('version')!r}")
+    return [Finding.from_dict(d) for d in doc.get("findings", [])]
+
+
+def partition(findings: Sequence[Finding],
+              baseline: Sequence[Finding],
+              ) -> Tuple[List[Finding], List[Finding]]:
+    """Split ``findings`` into (new, baselined) by multiset fingerprint
+    match — two identical snippets in one scope need two baseline
+    entries, so a *second* copy of an accepted violation still fails."""
+    budget = Counter(f.fingerprint() for f in baseline)
+    new: List[Finding] = []
+    known: List[Finding] = []
+    for f in findings:
+        fp = f.fingerprint()
+        if budget.get(fp, 0) > 0:
+            budget[fp] -= 1
+            known.append(f)
+        else:
+            new.append(f)
+    return new, known

@@ -1,0 +1,236 @@
+"""retrace_guard — a pytest plugin that makes jit-cache behavior testable.
+
+quiverlint's QT002 catches retrace hazards *statically*; this plugin is
+the runtime companion: it counts how many executables the data layer
+actually builds while a test runs and fails the test when that count
+exceeds a declared budget::
+
+    @pytest.mark.retrace_budget(3)           # at most 3 jit builds
+    def test_interleaved_batches(sampler):
+        for b in [8, 16, 8, 32, 16, 8]:      # 3 distinct shapes
+            sampler.sample(np.arange(b))
+
+    @pytest.mark.retrace_budget(1, backend_compiles=2)
+    def test_steady_state(...): ...
+
+What counts as a "build": construction of a fresh library-level
+executable — ``GraphSageSampler._build_jit``, a ``Feature._merge_cache``
+miss, an ``InferenceServer._fused_fns`` miss, a
+``HeteroGraphSageSampler._jitted`` miss.  ``backend_compiles``
+additionally bounds XLA backend compiles observed through jax's
+monitoring events (best effort: the hook is a private jax API, so the
+listener degrades to "unavailable" rather than erroring if it moves).
+
+Wiring: ``tests/conftest.py`` re-exports this module's hooks with
+``from quiver_tpu.analysis.retrace_guard import *`` *after* its device
+environment setup.  The module deliberately imports only pytest and
+stdlib at top level — quiver_tpu (and therefore jax) load lazily inside
+the counting context, so listing the plugin never defeats conftest's
+``JAX_PLATFORMS`` / ``XLA_FLAGS`` staging.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import threading
+from typing import List, Optional, Tuple
+
+import pytest
+
+__all__ = [
+    "JitBuildCounter", "count_jit_builds", "enforce_budget",
+    "pytest_configure", "pytest_runtest_call",
+]
+
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+
+class JitBuildCounter:
+    """Tally of executable builds observed inside a counting context."""
+
+    def __init__(self) -> None:
+        self.builds = 0
+        self.backend_compiles = 0
+        self.backend_available = False
+        self.sites: List[Tuple[str, object]] = []  # (site, shape key)
+        self._lock = threading.Lock()
+
+    def record(self, site: str, key: object = None) -> None:
+        with self._lock:
+            self.builds += 1
+            self.sites.append((site, key))
+
+    def record_backend(self) -> None:
+        with self._lock:
+            self.backend_compiles += 1
+
+    def describe(self) -> str:
+        if not self.sites:
+            return "<no builds recorded>"
+        return ", ".join(
+            f"{site}({key})" if key is not None else site
+            for site, key in self.sites)
+
+
+def _count_calls(counter: JitBuildCounter, site: str):
+    """Every call to the wrapped method is one build (``_build_jit``)."""
+    def factory(orig):
+        @functools.wraps(orig)
+        def wrapped(self, *a, **kw):
+            counter.record(site, a[0] if a else kw.get("batch_size"))
+            return orig(self, *a, **kw)
+        return wrapped
+    return factory
+
+
+def _count_cache_growth(counter: JitBuildCounter, site: str,
+                        cache_attr: str):
+    """A call is a build iff it grew the instance's executable cache —
+    robust to the method's own key derivation (miss-detection by delta,
+    not by re-implementing the key)."""
+    def factory(orig):
+        @functools.wraps(orig)
+        def wrapped(self, *a, **kw):
+            cache = getattr(self, cache_attr, None)
+            before = len(cache) if cache is not None else 0
+            out = orig(self, *a, **kw)
+            cache = getattr(self, cache_attr, None)
+            after = len(cache) if cache is not None else 0
+            for _ in range(max(after - before, 0)):
+                counter.record(site)
+            return out
+        return wrapped
+    return factory
+
+
+def _register_backend_listener(counter: JitBuildCounter):
+    """Best-effort XLA compile-event listener (private jax API)."""
+    try:
+        from jax._src import monitoring
+    except ImportError:
+        return None
+
+    def listener(event, duration, **kw):
+        if event == _COMPILE_EVENT:
+            counter.record_backend()
+
+    try:
+        monitoring.register_event_duration_secs_listener(listener)
+    except Exception:
+        return None
+    counter.backend_available = True
+    return listener
+
+
+def _unregister_backend_listener(listener) -> None:
+    if listener is None:
+        return
+    try:
+        from jax._src import monitoring
+        monitoring._unregister_event_duration_listener_by_callback(listener)
+    except Exception:
+        pass
+
+
+@contextlib.contextmanager
+def count_jit_builds():
+    """Context manager: patch the library's executable-build sites and
+    yield a live :class:`JitBuildCounter`.  Usable directly in tests for
+    exact assertions (``assert c.builds == 2``) — the marker is sugar
+    over this."""
+    counter = JitBuildCounter()
+    patched: List[Tuple[type, str, object]] = []
+
+    def patch(cls, name, factory):
+        orig = cls.__dict__.get(name)
+        if orig is None:       # subclass without an override: base covers
+            return
+        setattr(cls, name, factory(orig))
+        patched.append((cls, name, orig))
+
+    try:
+        from quiver_tpu.sampler import GraphSageSampler
+        patch(GraphSageSampler, "_build_jit",
+              _count_calls(counter, "sampler._build_jit"))
+    except ImportError:
+        pass
+    try:
+        from quiver_tpu.feature import Feature
+        patch(Feature, "_merge_fn",
+              _count_cache_growth(counter, "feature._merge_fn",
+                                  "_merge_cache"))
+    except ImportError:
+        pass
+    try:
+        from quiver_tpu.serving import InferenceServer
+        patch(InferenceServer, "_fused_forward",
+              _count_cache_growth(counter, "serving._fused_forward",
+                                  "_fused_fns"))
+    except ImportError:
+        pass
+    try:
+        from quiver_tpu.hetero import HeteroGraphSageSampler
+        patch(HeteroGraphSageSampler, "sample",
+              _count_cache_growth(counter, "hetero.sample", "_jitted"))
+    except ImportError:
+        pass
+
+    listener = _register_backend_listener(counter)
+    try:
+        yield counter
+    finally:
+        _unregister_backend_listener(listener)
+        for cls, name, orig in reversed(patched):
+            setattr(cls, name, orig)
+
+
+def enforce_budget(counter: JitBuildCounter, builds: Optional[int],
+                   backend_compiles: Optional[int] = None,
+                   nodeid: str = "", fail=None) -> None:
+    """Fail (via ``pytest.fail`` by default) if ``counter`` exceeded the
+    budget.  Split out from the hook so the failure path is unit-testable
+    without running a nested pytest."""
+    fail = fail or pytest.fail
+    where = nodeid or "<test>"
+    if builds is not None and counter.builds > builds:
+        fail(f"retrace budget exceeded: {counter.builds} jit build(s) > "
+             f"budget {builds} for {where} — every extra build is a "
+             f"latency cliff at serving time. Build sites: "
+             f"{counter.describe()}", pytrace=False)
+    if backend_compiles is not None and counter.backend_available \
+            and counter.backend_compiles > backend_compiles:
+        fail(f"retrace budget exceeded: {counter.backend_compiles} XLA "
+             f"backend compile(s) > budget {backend_compiles} for "
+             f"{where}", pytrace=False)
+
+
+def _parse_marker(marker) -> Tuple[Optional[int], Optional[int]]:
+    builds = marker.args[0] if marker.args else marker.kwargs.get("builds")
+    backend = marker.kwargs.get("backend_compiles")
+    if builds is None and backend is None:
+        raise pytest.UsageError(
+            "retrace_budget marker needs a budget: "
+            "@pytest.mark.retrace_budget(N) or "
+            "retrace_budget(backend_compiles=N)")
+    return builds, backend
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "retrace_budget(builds, backend_compiles=None): fail the test if "
+        "the data layer builds more than `builds` jit executables "
+        "(or exceeds `backend_compiles` XLA compiles) while it runs")
+
+
+@pytest.hookimpl(wrapper=True)
+def pytest_runtest_call(item):
+    marker = item.get_closest_marker("retrace_budget")
+    if marker is None:
+        return (yield)
+    builds, backend = _parse_marker(marker)
+    with count_jit_builds() as counter:
+        result = yield          # test exceptions propagate past the patch
+    enforce_budget(counter, builds, backend, nodeid=item.nodeid)
+    return result

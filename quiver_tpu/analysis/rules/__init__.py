@@ -1,0 +1,21 @@
+"""quiverlint rule registry — one module per rule, ordered by code."""
+
+from __future__ import annotations
+
+from typing import List
+
+from ..core import Rule
+from .qt001_host_sync import HostSyncRule
+from .qt002_retrace import RetraceRule
+from .qt003_locks import LockDisciplineRule
+from .qt004_layering import ImportLayeringRule
+from .qt005_hygiene import HygieneRule
+
+__all__ = ["all_rules", "RULE_CLASSES"]
+
+RULE_CLASSES = (HostSyncRule, RetraceRule, LockDisciplineRule,
+                ImportLayeringRule, HygieneRule)
+
+
+def all_rules() -> List[Rule]:
+    return [cls() for cls in RULE_CLASSES]

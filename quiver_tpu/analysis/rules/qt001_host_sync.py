@@ -1,0 +1,164 @@
+"""QT001 — host sync in a hot path.
+
+Every ``jax.device_get`` / ``.block_until_ready()`` / host cast of a
+device value inside the sampling -> gather -> serve pipeline stalls the
+dispatch queue for a full device round-trip; at serving rates that is
+the difference between "as fast as the hardware allows" and a host-bound
+pipeline (the GNNSampler / SALIENT data-layer tax).  Sync points that
+are part of the design (timing probes, A/B serialization baselines) get
+an inline ``# quiverlint: ignore[QT001]`` with a justification.
+
+Detection is deliberately local and conservative:
+
+  * any call to ``jax.device_get`` / ``jax.block_until_ready`` or any
+    ``<expr>.block_until_ready()`` in a hot module is flagged outright;
+  * ``np.asarray`` / ``np.array`` / ``int`` / ``float`` / ``bool`` are
+    flagged only when the argument is *known* to be a device value — a
+    name assigned (possibly through arithmetic) from a ``jnp.*`` /
+    ``jax.*`` call in the same function, or a direct ``jnp.*``/``jax.*``
+    call expression.  Host-side numpy stays unflagged.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Set
+
+from ..core import Finding, ModuleContext, Rule, dotted_call_name
+
+_SYNC_CALLS = {"jax.device_get", "jax.block_until_ready"}
+_CASTS = {"int", "float", "bool"}
+_MATERIALIZE = {"np.asarray", "np.array", "numpy.asarray", "numpy.array"}
+_DEVICE_ROOTS = {"jnp", "jax"}
+
+
+def _is_device_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    name = dotted_call_name(node.func)
+    return bool(name) and name.split(".", 1)[0] in _DEVICE_ROOTS
+
+
+# numpy-array methods that keep a host value host when chained onto a
+# materializer: np.asarray(x).copy() etc.
+_HOST_CHAIN = {"copy", "astype", "ravel", "item", "tolist", "reshape"}
+
+
+def _materialized(value: ast.AST) -> bool:
+    """True if ``value`` is a host materialization at its root — e.g.
+    ``np.asarray(x)``, ``int(x)``, ``np.asarray(x).copy()``.  Such an
+    assignment yields a HOST value: downstream casts of it are free."""
+    node = value
+    while True:
+        if (isinstance(node, ast.Call) and isinstance(node.func,
+                                                      ast.Attribute)
+                and node.func.attr in _HOST_CHAIN):
+            node = node.func.value
+        elif isinstance(node, ast.Subscript):
+            node = node.value
+        else:
+            break
+    if isinstance(node, ast.Call):
+        name = dotted_call_name(node.func)
+        return name in _MATERIALIZE or name in _CASTS
+    return False
+
+
+def _target_names(target: ast.AST) -> Set[str]:
+    """Plain names (re)bound by an assignment target.  Attribute and
+    subscript targets bind no local name (`self.x = jnp...` must not
+    mark `self` as a device value)."""
+    if isinstance(target, ast.Name):
+        return {target.id}
+    if isinstance(target, (ast.Tuple, ast.List)):
+        out: Set[str] = set()
+        for e in target.elts:
+            out |= _target_names(e)
+        return out
+    if isinstance(target, ast.Starred):
+        return _target_names(target.value)
+    return set()
+
+
+def _tracked_names(fn: ast.AST) -> Set[str]:
+    """Names assigned from jnp./jax. calls in ``fn``, propagated through
+    arithmetic and intermediate calls to a fixed point
+    (``g = branch * (1.0 + mean(g))``); a name rebound from a
+    materializer (``np.asarray(...)``) is host, not device."""
+    tracked: Set[str] = set()
+    assigns = [n for n in ast.walk(fn) if isinstance(n, (ast.Assign,
+                                                         ast.AugAssign))]
+
+    def mentions(expr: ast.AST) -> bool:
+        for sub in ast.walk(expr):
+            if _is_device_call(sub):
+                return True
+            if isinstance(sub, ast.Name) and sub.id in tracked:
+                return True
+        return False
+
+    changed = True
+    while changed:
+        changed = False
+        for a in assigns:
+            if _materialized(a.value) or not mentions(a.value):
+                continue
+            targets = a.targets if isinstance(a, ast.Assign) else [a.target]
+            for t in targets:
+                for name in _target_names(t):
+                    if name not in tracked:
+                        tracked.add(name)
+                        changed = True
+    return tracked
+
+
+class HostSyncRule(Rule):
+    code = "QT001"
+    name = "host-sync-in-hot-path"
+    description = ("device_get / block_until_ready / host casts of device "
+                   "values inside hot-path modules")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if not ctx.is_hot():
+            return
+        for qual, fn in ctx.functions:
+            tracked = None  # computed lazily: most functions are clean
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = dotted_call_name(node.func) or ""
+                if name in _SYNC_CALLS:
+                    yield ctx.finding(
+                        self.code, node,
+                        f"explicit host sync `{name}` in hot path "
+                        "(blocks the dispatch queue per batch)",
+                        scope=qual)
+                    continue
+                if (isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "block_until_ready"):
+                    yield ctx.finding(
+                        self.code, node,
+                        "`.block_until_ready()` in hot path (host sync)",
+                        scope=qual)
+                    continue
+                if name in _CASTS or name in _MATERIALIZE:
+                    if not node.args:
+                        continue
+                    arg = node.args[0]
+                    # the inner device_get was already flagged above
+                    if any(dotted_call_name(s.func) in _SYNC_CALLS
+                           for s in ast.walk(arg)
+                           if isinstance(s, ast.Call)):
+                        continue
+                    if tracked is None:
+                        tracked = _tracked_names(fn)
+                    direct = any(_is_device_call(s) for s in ast.walk(arg))
+                    via_name = any(isinstance(s, ast.Name)
+                                   and s.id in tracked
+                                   for s in ast.walk(arg))
+                    if direct or via_name:
+                        yield ctx.finding(
+                            self.code, node,
+                            f"`{name}(...)` materializes a device value on "
+                            "host in a hot path (implicit device_get)",
+                            scope=qual)

@@ -1,0 +1,199 @@
+"""QT002 — retrace hazards.
+
+XLA executables are keyed by (function identity, abstract shapes, static
+values).  Three Python-side patterns silently defeat that cache and turn
+a "compile once, serve forever" pipeline into a compile-per-call one:
+
+  * a fresh ``lambda`` passed to ``jax.jit`` at a call site — every call
+    makes a new function object, so the jit cache can never hit (unless
+    the caller caches the wrapped result; if it provably does, suppress
+    with a justification or restructure to ``jax.jit(self._method)``);
+  * any ``jax.jit(...)`` call inside a loop body — one traced program
+    per iteration;
+  * a jit-decorated function whose *traced* parameter flows into a shape
+    (``jnp.zeros(n)``, ``x.reshape(b, -1)``, ``jax.random.split(key,
+    n)``): every distinct value is a distinct shape signature, i.e. a
+    recompile.  Mark it in ``static_argnames`` (and bucket its values)
+    or derive the size from an input array's shape;
+  * a jit-decorated function reading ``self.<attr>``: instance state is
+    captured at trace time, so later mutation is silently ignored (and
+    ``jit`` directly on a method retraces per instance).  Bind the
+    needed values to locals before the ``def`` — see
+    ``InferenceServer._fused_forward`` for the idiom.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional, Set, Tuple
+
+from ..core import Finding, ModuleContext, Rule, dotted_call_name
+
+_JIT_NAMES = {"jax.jit", "jit"}
+
+# func dotted name -> which positional args are shape-like
+# ("first" = arg 0 incl. tuple elements, "all" = every positional arg,
+# "second" = arg 1)
+_SHAPE_FUNCS = {
+    "jnp.zeros": "first", "jnp.ones": "first", "jnp.empty": "first",
+    "jnp.full": "first", "jnp.eye": "all", "jnp.arange": "all",
+    "jnp.broadcast_to": "second", "jnp.tile": "second",
+    "jax.numpy.zeros": "first", "jax.numpy.ones": "first",
+    "jax.numpy.arange": "all", "jax.random.split": "second",
+}
+
+
+def _is_jit(func: ast.AST) -> bool:
+    return dotted_call_name(func) in _JIT_NAMES
+
+
+def _jit_decoration(dec: ast.AST) -> Optional[Tuple[Set[str], Set[int]]]:
+    """(static_argnames, static_argnums) if ``dec`` is a jit decorator
+    (bare ``@jax.jit``, ``@jax.jit(...)``, or ``@partial(jax.jit, ...)``),
+    else None."""
+    if _is_jit(dec):
+        return set(), set()
+    if not isinstance(dec, ast.Call):
+        return None
+    kwargs = None
+    if _is_jit(dec.func):
+        kwargs = dec.keywords
+    elif (dotted_call_name(dec.func) in ("functools.partial", "partial")
+          and dec.args and _is_jit(dec.args[0])):
+        kwargs = dec.keywords
+    if kwargs is None:
+        return None
+    names: Set[str] = set()
+    nums: Set[int] = set()
+    for kw in kwargs:
+        if kw.arg == "static_argnames":
+            for c in ast.walk(kw.value):
+                if isinstance(c, ast.Constant) and isinstance(c.value, str):
+                    names.add(c.value)
+        elif kw.arg == "static_argnums":
+            for c in ast.walk(kw.value):
+                if isinstance(c, ast.Constant) and isinstance(c.value, int):
+                    nums.add(c.value)
+    return names, nums
+
+
+def _param_names(fn: ast.AST) -> List[str]:
+    a = fn.args
+    return [x.arg for x in (*a.posonlyargs, *a.args, *a.kwonlyargs)]
+
+
+def _shape_name_uses(fn: ast.AST) -> Iterator[Tuple[str, ast.AST]]:
+    """(param-candidate name, node) pairs where a bare Name appears in a
+    shape position inside ``fn``."""
+
+    def names_in(expr: ast.AST) -> Iterator[str]:
+        if isinstance(expr, ast.Name):
+            yield expr.id
+        elif isinstance(expr, (ast.Tuple, ast.List)):
+            for e in expr.elts:
+                if isinstance(e, ast.Name):
+                    yield e.id
+
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted_call_name(node.func)
+        spec = _SHAPE_FUNCS.get(name or "")
+        if spec:
+            if spec == "first" and node.args:
+                picked = [node.args[0]]
+            elif spec == "second" and len(node.args) > 1:
+                picked = [node.args[1]]
+            elif spec == "all":
+                picked = list(node.args)
+            else:
+                picked = []
+            for arg in picked:
+                for n in names_in(arg):
+                    yield n, node
+        elif (isinstance(node.func, ast.Attribute)
+              and node.func.attr == "reshape"):
+            for arg in node.args:
+                for n in names_in(arg):
+                    yield n, node
+
+
+class RetraceRule(Rule):
+    code = "QT002"
+    name = "retrace-hazard"
+    description = ("jit call-site and signature patterns that defeat the "
+                   "executable cache (fresh closures, jit in loops, "
+                   "shape-affecting traced params, mutable self capture)")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        yield from self._call_sites(ctx.tree, ctx, in_loop=False)
+        yield from self._decorated(ctx)
+
+    # -- jax.jit(...) call sites --------------------------------------
+    def _call_sites(self, node: ast.AST, ctx: ModuleContext,
+                    in_loop: bool) -> Iterator[Finding]:
+        for child in ast.iter_child_nodes(node):
+            child_in_loop = in_loop or isinstance(child, (ast.For,
+                                                          ast.While))
+            if isinstance(child, ast.Call) and _is_jit(child.func):
+                if in_loop:
+                    yield ctx.finding(
+                        self.code, child,
+                        "jax.jit(...) inside a loop: one fresh traced "
+                        "program per iteration; hoist and cache it")
+                elif child.args and isinstance(child.args[0], ast.Lambda):
+                    yield ctx.finding(
+                        self.code, child,
+                        "fresh lambda passed to jax.jit: each evaluation "
+                        "creates a new function object, so the jit cache "
+                        "never hits; jit a named function instead")
+            yield from self._call_sites(child, ctx, child_in_loop)
+
+    # -- @jax.jit-decorated defs --------------------------------------
+    def _decorated(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for qual, fn in ctx.functions:
+            statics: Optional[Set[str]] = None
+            for dec in fn.decorator_list:
+                got = _jit_decoration(dec)
+                if got is not None:
+                    names, nums = got
+                    params = _param_names(fn)
+                    statics = set(names)
+                    statics.update(params[i] for i in nums
+                                   if i < len(params))
+                    break
+            if statics is None:
+                continue
+            params = set(_param_names(fn))
+            reported: Set[str] = set()
+            for name, node in _shape_name_uses(fn):
+                if name in params and name not in statics \
+                        and name not in reported:
+                    reported.add(name)
+                    yield ctx.finding(
+                        self.code, node,
+                        f"traced parameter `{name}` flows into a shape: "
+                        "every distinct value recompiles; add it to "
+                        "static_argnames (and bucket its values) or derive "
+                        "the size from an input array's shape",
+                        scope=qual)
+            if "self" in params:
+                yield ctx.finding(
+                    self.code, fn,
+                    "jax.jit on a method traces `self` as an argument "
+                    "(retraces per instance); jit a free function or a "
+                    "closure over explicit locals",
+                    scope=qual)
+                continue
+            for node in ast.walk(fn):
+                if (isinstance(node, ast.Attribute)
+                        and isinstance(node.value, ast.Name)
+                        and node.value.id == "self"):
+                    yield ctx.finding(
+                        self.code, node,
+                        f"jit-traced function reads `self.{node.attr}`: "
+                        "instance state is baked in at trace time and "
+                        "later mutation is ignored; bind it to a local "
+                        "before the def",
+                        scope=qual)
+                    break

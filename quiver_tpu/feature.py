@@ -48,6 +48,10 @@ class DeviceConfig:
 class Feature:
     """Hot/cold cached node-feature store.
 
+    Lock discipline (quiverlint QT003): ``_pending`` is the prefetch
+    staging map shared between the pool worker and the gather path —
+    every mutation holds ``_plock`` (created lazily with the pool).
+
     Args:
       rank: local device index (parity arg; single-controller jax mostly
         ignores it).
@@ -60,6 +64,8 @@ class Feature:
       csr_topo: optional :class:`CSRTopo`; enables degree-ordered caching
         (``reindex_feature``) so high-degree rows land in the hot tier.
     """
+
+    _guarded_by = {"_pending": "_plock"}
 
     def __init__(self, rank: int = 0, device_list: Optional[Sequence] = None,
                  device_cache_size: Union[int, str] = 0,

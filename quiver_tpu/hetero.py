@@ -216,9 +216,9 @@ class HeteroGraphSageSampler:
         seeds = jnp.asarray(np.asarray(input_nodes), jnp.int32)
         B = seeds.shape[0]
         if B not in self._jitted:
-            self._jitted[B] = jax.jit(
-                lambda s, k: self._pipeline(s, k)
-            )
+            # jit the bound method directly — a fresh lambda here would
+            # defeat jax's executable cache if this dict were ever reset
+            self._jitted[B] = jax.jit(self._pipeline)
         if key is None:
             from .utils.rng import make_key
 

@@ -175,6 +175,9 @@ class MixedGraphSageSampler:
             for t in tpu_tasks:
                 t0 = time.perf_counter()
                 batch = self.tpu_sampler.sample(self.job[t])
+                # the adaptive CPU/TPU split needs the true TPU wall time,
+                # so this lane times to completion on purpose
+                # quiverlint: ignore[QT001]
                 batch.n_id.block_until_ready()
                 dt = time.perf_counter() - t0
                 tpu_times.append(dt)

@@ -21,6 +21,50 @@ from .utils.topology import CSRTopo
 __all__ = ["generate_neighbour_num"]
 
 
+def _expected_counts(indptr, indices, *, n, sizes):
+    """Reverse degree recurrence, fully on device.
+
+    g_L = 0; g_l[v] = min(k_l, deg[v]) * (1 + mean_{u in N(v)} g_{l+1}[u]);
+    expected total = g_1[v].  The mean over neighbors uses the uniform
+    sampling marginals.  ``n`` and ``sizes`` are static so the whole
+    recurrence compiles to one XLA program — no per-layer dispatch and a
+    single host materialization at the end.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    deg = (indptr[1:] - indptr[:-1]).astype(jnp.float32)
+    e = indices.shape[0]
+    row_of_edge = (
+        jnp.searchsorted(
+            indptr,
+            jnp.arange(e, dtype=indptr.dtype),
+            side="right",
+        ) - 1
+    )
+    g = jnp.zeros((n,), jnp.float32)
+    for k in reversed(sizes):
+        branch = jnp.minimum(float(k), deg)
+        s = jax.ops.segment_sum(g[indices], row_of_edge, num_segments=n)
+        g = branch * (1.0 + s / jnp.maximum(deg, 1.0))
+    return g
+
+
+_expected_counts_jit = None
+
+
+def _get_expected_counts_jit():
+    """Build (once) and return the jitted recurrence.  Module-level cache
+    so repeated calls with the same (n, sizes) reuse the executable."""
+    global _expected_counts_jit
+    if _expected_counts_jit is None:
+        import jax
+
+        _expected_counts_jit = jax.jit(
+            _expected_counts, static_argnames=("n", "sizes"))
+    return _expected_counts_jit
+
+
 def generate_neighbour_num(
     csr_topo: CSRTopo, sizes: Sequence[int], mode: str = "expected",
     n_threads: int = 0, seed: int = 7, path: str = None,
@@ -39,38 +83,14 @@ def generate_neighbour_num(
             n_threads=n_threads, seed=seed,
         )
     else:
-        import jax.numpy as jnp
-        import jax
-
         indptr, indices = csr_topo.to_device()
         n = csr_topo.node_count
         e = csr_topo.edge_count
         indptr = indptr[: n + 1]   # strip lane padding
         indices = indices[:e]
-        deg = (indptr[1:] - indptr[:-1]).astype(jnp.float32)
-        row_of_edge = (
-            jnp.searchsorted(
-                indptr,
-                jnp.arange(e, dtype=indptr.dtype),
-                side="right",
-            ) - 1
-        )
-
-        # Reverse dynamic program, vectorized over all nodes at once:
-        # g_L = 0; g_l[v] = min(k_l, deg[v]) * (1 + mean_{u in N(v)} g_{l+1}[u])
-        # expected total = g_1[v].  mean over neighbors uses the uniform
-        # sampling marginals.
-        import jax.ops
-
-        def mean_over_neighbors(g):
-            s = jax.ops.segment_sum(g[indices], row_of_edge, num_segments=n)
-            return s / jnp.maximum(deg, 1.0)
-
-        g = jnp.zeros((n,), jnp.float32)
-        for k in reversed(list(sizes)):
-            branch = jnp.minimum(float(k), deg)
-            g = branch * (1.0 + mean_over_neighbors(g))
-        out = np.asarray(jax.device_get(g)).astype(np.int64)
+        g = _get_expected_counts_jit()(
+            indptr, indices, n=n, sizes=tuple(int(k) for k in sizes))
+        out = np.asarray(g).astype(np.int64)
     if path is not None:
         np.save(path, out)
     return out

@@ -190,6 +190,11 @@ class InferenceServer:
     ``apply_fn(params, x, blocks)`` is the jitted model forward.
     """
 
+    # lock discipline (enforced by quiverlint QT003): the fused-executable
+    # cache is filled lazily from whichever worker thread first sees a
+    # bucket size, so every write must hold ``_lock``
+    _guarded_by = {"_fused_fns": "_lock"}
+
     BUCKETS = (8, 16, 32, 64, 128, 256, 512, 1024, 2048)
 
     def __init__(self, tpu_sampler, feature, apply_fn: Callable, params,
@@ -223,6 +228,7 @@ class InferenceServer:
                      and getattr(tpu_sampler, "mode", "TPU") == "TPU")
         self._fused = fused
         self._fused_fns = {}
+        self._lock = threading.Lock()
         self._threads: List[threading.Thread] = []
         self._stopped = threading.Event()
 
@@ -309,7 +315,11 @@ class InferenceServer:
                 x = feature.lookup_device(n_id)
                 return apply_fn(params, x, blocks)
 
-            self._fused_fns[B] = fn
+            # double-checked: the unlocked .get() above is the fast path;
+            # two threads racing a cold bucket both build, setdefault
+            # keeps exactly one (compile is lazy, losing a build is cheap)
+            with self._lock:
+                fn = self._fused_fns.setdefault(B, fn)
         return fn(self.params, jnp.asarray(padded_ids, jnp.int32),
                   make_key(np.random.randint(0, 2**31 - 1)))
 
@@ -568,14 +578,18 @@ class InferenceServer_Debug(InferenceServer):
     flow into the process registry via the base class.
     """
 
+    # QT003: latency accounting is written from every worker thread via
+    # _record_request; it shares the base class's ``_lock``
+    _guarded_by = {"_stage_acc": "_lock", "_count": "_lock",
+                   "_t_first": "_lock", "_t_last": "_lock"}
+
     def __init__(self, *args, **kwargs):
-        super().__init__(*args, **kwargs)
+        super().__init__(*args, **kwargs)  # base creates self._lock
         self._hist = telemetry.Histogram("serving_debug_latency")
         self._stage_acc: dict = {}  # stage -> [count, total_s]
         self._t_first = None
         self._t_last = None
         self._count = 0
-        self._lock = threading.Lock()
 
     def _record_request(self, req, lane, stages, t_dequeue, t_done):
         e2e, full = super()._record_request(req, lane, stages, t_dequeue,

@@ -233,7 +233,14 @@ class _HistTimer:
 
 
 class MetricsRegistry:
-    """Thread-safe name+labels -> metric store with snapshot/merge."""
+    """Thread-safe name+labels -> metric store with snapshot/merge.
+
+    QT003 lock discipline: the registry map is written from any thread
+    that first touches a metric name; all mutations hold ``_lock`` (the
+    unlocked ``.get()`` in ``_get`` is the double-checked fast path).
+    """
+
+    _guarded_by = {"_metrics": "_lock"}
 
     def __init__(self):
         self._lock = threading.Lock()

@@ -23,6 +23,12 @@ jax.config.update("jax_platforms", "cpu")
 import numpy as np
 import pytest
 
+# retrace_guard hooks (@pytest.mark.retrace_budget).  Re-exported here —
+# NOT listed via `-p` in pytest.ini — so the import happens after the
+# JAX_PLATFORMS / XLA_FLAGS staging above (the plugin pulls in
+# quiver_tpu, which imports jax).
+from quiver_tpu.analysis.retrace_guard import *  # noqa: F401,F403
+
 
 @pytest.fixture
 def rng():

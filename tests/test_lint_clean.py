@@ -1,0 +1,74 @@
+"""The repo must lint clean — quiverlint is part of tier-1.
+
+This is the CI gate the baseline workflow exists for: pre-existing,
+justified findings live in ``quiverlint.baseline.json``; anything new
+fails here.  The injected-violation tests prove the gate actually has
+teeth end to end (``python -m`` exit codes, not just library calls).
+"""
+
+import json
+import shutil
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from quiver_tpu.analysis import analyze_paths
+from quiver_tpu.analysis import baseline as baseline_mod
+
+REPO = Path(__file__).resolve().parents[1]
+LINT_TARGETS = ["quiver_tpu", "bench.py"]
+
+
+def test_repo_lints_clean_against_baseline():
+    result = analyze_paths(LINT_TARGETS, root=REPO)
+    assert result.errors == []
+    baseline = baseline_mod.load(REPO / baseline_mod.DEFAULT_BASELINE_NAME)
+    new, _ = baseline_mod.partition(result.findings, baseline)
+    assert new == [], "new quiverlint findings:\n" + "\n".join(
+        f.format() for f in new)
+
+
+def test_cli_exits_zero_on_repo():
+    proc = subprocess.run(
+        [sys.executable, "-m", "quiver_tpu.analysis", *LINT_TARGETS],
+        capture_output=True, text=True, timeout=300, cwd=str(REPO))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def _repo_copy_with(tmp_path, relpath, appended):
+    """Copy the lint targets into tmp_path and append ``appended`` to
+    ``relpath`` — an injected violation in an otherwise-clean tree."""
+    shutil.copytree(REPO / "quiver_tpu", tmp_path / "quiver_tpu")
+    shutil.copy(REPO / "bench.py", tmp_path / "bench.py")
+    shutil.copy(REPO / baseline_mod.DEFAULT_BASELINE_NAME,
+                tmp_path / baseline_mod.DEFAULT_BASELINE_NAME)
+    target = tmp_path / relpath
+    target.write_text(target.read_text() + appended)
+    return tmp_path
+
+
+@pytest.mark.parametrize("relpath, code, appended", [
+    ("quiver_tpu/sampler.py", "QT001",
+     "\n\ndef _leaky(x):\n"
+     "    import jax\n"
+     "    return jax.device_get(x)\n"),
+    ("quiver_tpu/sampler.py", "QT002",
+     "\n\ndef _retracey(f, xs):\n"
+     "    import jax\n"
+     "    for x in xs:\n"
+     "        x = jax.jit(f)(x)\n"
+     "    return x\n"),
+])
+def test_injected_violation_fails_cli(tmp_path, relpath, code, appended):
+    root = _repo_copy_with(tmp_path, relpath, appended)
+    proc = subprocess.run(
+        [sys.executable, "-m", "quiver_tpu.analysis", *LINT_TARGETS,
+         "--format", "json"],
+        capture_output=True, text=True, timeout=300, cwd=str(root),
+        env=None)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout)
+    assert [f["rule"] for f in doc["findings"]] == [code]
+    assert doc["findings"][0]["path"] == relpath

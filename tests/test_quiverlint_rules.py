@@ -1,0 +1,425 @@
+"""quiverlint rule tests: one true-positive and one clean-negative
+fixture per rule, plus suppression and baseline round-trips.
+
+All fixtures are tmp_path files run through the real ``analyze_paths``
+entry point (not rule internals), so these tests also cover file
+discovery, relpath handling, and suppression plumbing.
+"""
+
+import json
+import textwrap
+
+import pytest
+
+from quiver_tpu.analysis import LintConfig, analyze_paths
+from quiver_tpu.analysis import baseline as baseline_mod
+from quiver_tpu.analysis.cli import main as lint_main
+
+ALL_HOT = ("*.py",)          # fixtures opt into hot-path rules by config
+
+
+def run_lint(tmp_path, source, name="mod.py", **cfg):
+    p = tmp_path / name
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(textwrap.dedent(source))
+    return analyze_paths([str(p)], config=LintConfig(**cfg), root=tmp_path)
+
+
+def codes(result):
+    return sorted(f.rule for f in result.findings)
+
+
+# ------------------------------------------------------------ QT001
+class TestHostSync:
+    def test_flags_device_get_and_block_until_ready(self, tmp_path):
+        r = run_lint(tmp_path, """
+            import jax
+
+            def hot_loop(x):
+                y = jax.device_get(x)
+                x.block_until_ready()
+                return y
+        """, hot_modules=ALL_HOT)
+        assert codes(r) == ["QT001", "QT001"]
+
+    def test_flags_cast_of_tracked_device_value(self, tmp_path):
+        r = run_lint(tmp_path, """
+            import jax.numpy as jnp
+
+            def f(a):
+                x = jnp.cumsum(a)
+                total = x * 2 + 1
+                return int(total[-1])
+        """, hot_modules=ALL_HOT)
+        assert codes(r) == ["QT001"]
+        assert "int(...)" in r.findings[0].message
+
+    def test_host_numpy_cast_is_clean(self, tmp_path):
+        r = run_lint(tmp_path, """
+            import numpy as np
+
+            def f(a):
+                y = np.cumsum(a)
+                return int(y[-1])
+        """, hot_modules=ALL_HOT)
+        assert r.findings == []
+
+    def test_materialized_value_is_host_afterwards(self, tmp_path):
+        # the np.asarray IS the (single) sync; casting the result is free
+        r = run_lint(tmp_path, """
+            import jax.numpy as jnp
+            import numpy as np
+
+            def f(a):
+                h = np.asarray(jnp.cumsum(a))
+                return int(h[-1]), float(h[0])
+        """, hot_modules=ALL_HOT)
+        assert codes(r) == ["QT001"]
+        assert "np.asarray" in r.findings[0].snippet
+
+    def test_attribute_target_does_not_poison_self(self, tmp_path):
+        # regression: `self.x = jnp...` must not mark `self` as a device
+        # value and flag every later `int(self.anything)`
+        r = run_lint(tmp_path, """
+            import jax.numpy as jnp
+
+            class G:
+                def __init__(self, a):
+                    self.dev = jnp.asarray(a)
+                    self.n = int(len(a))
+        """, hot_modules=ALL_HOT)
+        assert r.findings == []
+
+    def test_cold_module_is_exempt(self, tmp_path):
+        r = run_lint(tmp_path, """
+            import jax
+
+            def f(x):
+                return jax.device_get(x)
+        """, name="cold.py", hot_modules=("hot_*.py",))
+        assert r.findings == []
+
+
+# ------------------------------------------------------------ QT002
+class TestRetrace:
+    def test_flags_jit_lambda(self, tmp_path):
+        r = run_lint(tmp_path, """
+            import jax
+
+            def make(f):
+                return jax.jit(lambda x: f(x))
+        """)
+        assert codes(r) == ["QT002"]
+        assert "lambda" in r.findings[0].message
+
+    def test_flags_jit_in_loop(self, tmp_path):
+        r = run_lint(tmp_path, """
+            import jax
+
+            def run(fs, x):
+                for f in fs:
+                    x = jax.jit(f)(x)
+                return x
+        """)
+        assert codes(r) == ["QT002"]
+        assert "loop" in r.findings[0].message
+
+    def test_cached_named_jit_is_clean(self, tmp_path):
+        r = run_lint(tmp_path, """
+            import jax
+
+            def pipeline(x):
+                return x
+
+            _fn = jax.jit(pipeline)
+
+            def run(x):
+                return _fn(x)
+        """)
+        assert r.findings == []
+
+    def test_flags_traced_param_in_shape(self, tmp_path):
+        r = run_lint(tmp_path, """
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def pad(x, n):
+                return jnp.zeros((n, 4)) + x
+        """)
+        assert codes(r) == ["QT002"]
+        assert "`n`" in r.findings[0].message
+
+    def test_static_argnames_makes_shape_param_clean(self, tmp_path):
+        r = run_lint(tmp_path, """
+            import functools
+            import jax
+            import jax.numpy as jnp
+
+            @functools.partial(jax.jit, static_argnames=("n",))
+            def pad(x, n):
+                return jnp.zeros((n, 4)) + x
+        """)
+        assert r.findings == []
+
+    def test_flags_jit_method_tracing_self(self, tmp_path):
+        r = run_lint(tmp_path, """
+            import jax
+
+            class S:
+                @jax.jit
+                def fwd(self, x):
+                    return x * self.scale
+        """)
+        assert codes(r) == ["QT002"]
+        assert "self" in r.findings[0].message
+
+
+# ------------------------------------------------------------ QT003
+class TestLockDiscipline:
+    GUARDED = """
+        import threading
+
+        class S:
+            _guarded_by = {{"_cache": "_lock"}}
+
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cache = {{}}
+
+            def touch(self, k, v):
+                {body}
+    """
+
+    def test_flags_unlocked_mutation(self, tmp_path):
+        r = run_lint(tmp_path, self.GUARDED.format(
+            body="self._cache[k] = v"))
+        assert codes(r) == ["QT003"]
+        assert "_lock" in r.findings[0].message
+
+    def test_flags_unlocked_mutator_method(self, tmp_path):
+        r = run_lint(tmp_path, self.GUARDED.format(
+            body="self._cache.setdefault(k, v)"))
+        assert codes(r) == ["QT003"]
+
+    def test_locked_mutation_is_clean(self, tmp_path):
+        r = run_lint(tmp_path, self.GUARDED.format(
+            body="with self._lock:\n                    self._cache[k] = v"))
+        assert r.findings == []
+
+    def test_init_and_reads_are_exempt(self, tmp_path):
+        r = run_lint(tmp_path, """
+            import threading
+
+            class S:
+                _guarded_by = {"_cache": "_lock"}
+
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._cache = {}   # construction: exempt
+
+                def get(self, k):
+                    return self._cache.get(k)   # racy read: allowed
+        """)
+        assert r.findings == []
+
+    def test_nested_def_does_not_inherit_lock(self, tmp_path):
+        # a worker closure defined inside `with self._lock:` runs LATER,
+        # outside the lock — writing there must still be flagged
+        r = run_lint(tmp_path, """
+            import threading
+
+            class S:
+                _guarded_by = {"_cache": "_lock"}
+
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._cache = {}
+
+                def schedule(self, pool, k, v):
+                    with self._lock:
+                        def work():
+                            self._cache[k] = v
+                        pool.submit(work)
+        """)
+        assert codes(r) == ["QT003"]
+
+
+# ------------------------------------------------------------ QT004
+class TestImportLayering:
+    def test_flags_module_level_exporter_import(self, tmp_path):
+        r = run_lint(tmp_path, """
+            from quiver_tpu.telemetry.export import start_http_server
+
+            def serve():
+                return start_http_server()
+        """)
+        assert codes(r) == ["QT004"]
+
+    def test_flags_http_server_import(self, tmp_path):
+        r = run_lint(tmp_path, "import http.server\n")
+        assert codes(r) == ["QT004"]
+
+    def test_function_local_import_is_clean(self, tmp_path):
+        r = run_lint(tmp_path, """
+            def expose_metrics():
+                from quiver_tpu.telemetry.export import start_http_server
+                return start_http_server()
+        """)
+        assert r.findings == []
+
+    def test_exempt_module_is_clean(self, tmp_path):
+        r = run_lint(tmp_path, "import http.server\n",
+                     name="exporter.py",
+                     layering_exempt=("exporter.py",))
+        assert r.findings == []
+
+
+# ------------------------------------------------------------ QT005
+class TestHygiene:
+    def test_flags_mutable_default_and_bare_except(self, tmp_path):
+        r = run_lint(tmp_path, """
+            def f(xs=[]):
+                try:
+                    return xs
+                except:
+                    return None
+        """)
+        assert codes(r) == ["QT005", "QT005"]
+
+    def test_clean_defaults_and_typed_except(self, tmp_path):
+        r = run_lint(tmp_path, """
+            def f(xs=None, n=3, name="x"):
+                try:
+                    return xs or []
+                except ValueError:
+                    return None
+        """)
+        assert r.findings == []
+
+
+# ------------------------------------------------ suppression plumbing
+class TestSuppression:
+    def test_same_line_suppression(self, tmp_path):
+        r = run_lint(tmp_path, """
+            import jax
+
+            def f(x):
+                return jax.device_get(x)  # quiverlint: ignore[QT001] -- probe
+        """, hot_modules=ALL_HOT)
+        assert r.findings == []
+        assert [f.rule for f in r.suppressed] == ["QT001"]
+
+    def test_comment_line_above_covers_justification_block(self, tmp_path):
+        r = run_lint(tmp_path, """
+            import jax
+
+            def f(x):
+                # quiverlint: ignore[QT001]
+                # this sync is the serialized baseline arm of the A/B
+                return jax.device_get(x)
+        """, hot_modules=ALL_HOT)
+        assert r.findings == []
+        assert [f.rule for f in r.suppressed] == ["QT001"]
+
+    def test_suppression_is_rule_specific(self, tmp_path):
+        # an ignore[QT005] must not hide a QT001 on the same line
+        r = run_lint(tmp_path, """
+            import jax
+
+            def f(x):
+                return jax.device_get(x)  # quiverlint: ignore[QT005]
+        """, hot_modules=ALL_HOT)
+        assert codes(r) == ["QT001"]
+
+
+# ------------------------------------------------------------ baseline
+class TestBaseline:
+    SRC = """
+        import jax
+
+        def f(x):
+            return jax.device_get(x)
+    """
+
+    def test_round_trip_and_partition(self, tmp_path):
+        r = run_lint(tmp_path, self.SRC, hot_modules=ALL_HOT)
+        bl = tmp_path / "bl.json"
+        baseline_mod.save(bl, r.findings)
+        accepted = baseline_mod.load(bl)
+        assert [f.fingerprint() for f in accepted] \
+            == [f.fingerprint() for f in r.findings]
+        new, known = baseline_mod.partition(r.findings, accepted)
+        assert new == [] and len(known) == 1
+
+    def test_baseline_survives_line_shift_not_edit(self, tmp_path):
+        r1 = run_lint(tmp_path, self.SRC, hot_modules=ALL_HOT)
+        bl = tmp_path / "bl.json"
+        baseline_mod.save(bl, r1.findings)
+        # unrelated lines above: finding moves, fingerprint doesn't
+        r2 = run_lint(tmp_path, "import os\nX = 1\n"
+                      + textwrap.dedent(self.SRC), hot_modules=ALL_HOT)
+        new, known = baseline_mod.partition(
+            r2.findings, baseline_mod.load(bl))
+        assert new == [] and len(known) == 1
+        # editing the flagged line itself invalidates the entry
+        r3 = run_lint(tmp_path, self.SRC.replace(
+            "jax.device_get(x)", "jax.device_get(x[:1])"),
+            hot_modules=ALL_HOT)
+        new, known = baseline_mod.partition(
+            r3.findings, baseline_mod.load(bl))
+        assert len(new) == 1 and known == []
+
+    def test_second_copy_of_baselined_violation_is_new(self, tmp_path):
+        r1 = run_lint(tmp_path, self.SRC, hot_modules=ALL_HOT)
+        bl = tmp_path / "bl.json"
+        baseline_mod.save(bl, r1.findings)
+        doubled = textwrap.dedent(self.SRC) + textwrap.dedent("""
+            def g(x):
+                return jax.device_get(x)
+        """)
+        r2 = run_lint(tmp_path, doubled, hot_modules=ALL_HOT)
+        new, known = baseline_mod.partition(
+            r2.findings, baseline_mod.load(bl))
+        # same snippet, different scope -> g's copy is NEW
+        assert len(known) == 1 and len(new) == 1
+        assert new[0].scope == "g"
+
+
+# ------------------------------------------------------------ CLI
+class TestCli:
+    def test_exit_codes_and_baseline_flow(self, tmp_path, capsys):
+        mod = tmp_path / "quiver_tpu" / "sampler.py"
+        mod.parent.mkdir()
+        mod.write_text("import jax\n\n"
+                       "def f(x):\n"
+                       "    return jax.device_get(x)\n")
+        root = str(tmp_path)
+        assert lint_main(["quiver_tpu", "--root", root]) == 1
+        assert lint_main(["quiver_tpu", "--root", root,
+                          "--write-baseline"]) == 0
+        assert (tmp_path / "quiverlint.baseline.json").exists()
+        assert lint_main(["quiver_tpu", "--root", root]) == 0
+        assert lint_main(["quiver_tpu", "--root", root,
+                          "--no-baseline"]) == 1
+        capsys.readouterr()
+        assert lint_main(["quiver_tpu", "--root", root, "--no-baseline",
+                          "--format", "json"]) == 1
+        doc = json.loads(capsys.readouterr().out)
+        assert [f["rule"] for f in doc["findings"]] == ["QT001"]
+
+    def test_rule_selection(self, tmp_path):
+        mod = tmp_path / "quiver_tpu" / "sampler.py"
+        mod.parent.mkdir()
+        mod.write_text("import jax\n\n"
+                       "def f(x):\n"
+                       "    return jax.device_get(x)\n")
+        assert lint_main(["quiver_tpu", "--root", str(tmp_path),
+                          "--no-baseline", "--rules", "QT005"]) == 0
+
+    def test_syntax_error_exits_2(self, tmp_path, capsys):
+        bad = tmp_path / "bad.py"
+        bad.write_text("def f(:\n")
+        assert lint_main([str(bad), "--root", str(tmp_path),
+                          "--no-baseline"]) == 2
+        assert "error" in capsys.readouterr().err

@@ -1,0 +1,133 @@
+"""retrace_guard: the runtime half of the retrace contract.
+
+Covers the counting context manager directly (exact build counts on the
+real sampler), the ``@pytest.mark.retrace_budget`` marker in-process on
+the passing path, the enforcement failure path as a unit, and — the
+acceptance check — a subprocess pytest run where a deliberately
+cache-busting test MUST fail with "retrace budget exceeded".
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import numpy as np
+import jax
+import pytest
+
+from quiver_tpu import GraphSageSampler
+from quiver_tpu.analysis.retrace_guard import (
+    JitBuildCounter, count_jit_builds, enforce_budget,
+)
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+# ----------------------------------------------------- counting context
+def test_counts_one_build_per_distinct_batch_size(small_graph):
+    s = GraphSageSampler(small_graph, [4, 3])
+    with count_jit_builds() as c:
+        for B in [8, 16, 8, 32, 16, 8, 32]:
+            s.sample(np.arange(B, dtype=np.int64),
+                     key=jax.random.PRNGKey(B))
+    assert c.builds == 3
+    assert sorted(k for _, k in c.sites) == [8, 16, 32]
+    assert all(site == "sampler._build_jit" for site, _ in c.sites)
+    # warm cache: a second sweep builds nothing
+    with count_jit_builds() as c2:
+        for B in [8, 16, 32]:
+            s.sample(np.arange(B, dtype=np.int64),
+                     key=jax.random.PRNGKey(B))
+    assert c2.builds == 0
+
+
+def test_patches_are_restored_on_exit(small_graph):
+    before = GraphSageSampler.__dict__["_build_jit"]
+    with count_jit_builds():
+        assert GraphSageSampler.__dict__["_build_jit"] is not before
+    assert GraphSageSampler.__dict__["_build_jit"] is before
+
+
+def test_backend_compile_listener_sees_xla_compiles(small_graph):
+    s = GraphSageSampler(small_graph, [4, 3])
+    with count_jit_builds() as c:
+        s.sample(np.arange(64, dtype=np.int64), key=jax.random.PRNGKey(0))
+    if not c.backend_available:   # private jax API moved: soft-degrade
+        pytest.skip("jax monitoring listener unavailable")
+    assert c.builds == 1
+    assert c.backend_compiles >= 1
+
+
+# ----------------------------------------------------- marker: pass path
+@pytest.mark.retrace_budget(3)
+def test_marker_passes_within_budget(small_graph):
+    s = GraphSageSampler(small_graph, [4, 3])
+    for B in [8, 16, 8, 32, 16, 8, 32]:   # 3 distinct shapes == budget
+        b = s.sample(np.arange(B, dtype=np.int64),
+                     key=jax.random.PRNGKey(B))
+        assert b.batch_size == B
+
+
+# ----------------------------------------------------- enforcement unit
+def test_enforce_budget_failure_message():
+    c = JitBuildCounter()
+    for B in (8, 16, 32, 64):
+        c.record("sampler._build_jit", B)
+    with pytest.raises(pytest.fail.Exception,
+                       match=r"retrace budget exceeded: 4 jit build"):
+        enforce_budget(c, builds=3, nodeid="test_x")
+    enforce_budget(c, builds=4)   # at the budget: no failure
+
+    c.backend_available = True
+    c.backend_compiles = 9
+    with pytest.raises(pytest.fail.Exception, match="backend compile"):
+        enforce_budget(c, builds=None, backend_compiles=2)
+
+
+def test_enforce_budget_ignores_backend_when_unavailable():
+    c = JitBuildCounter()
+    c.backend_compiles = 9        # stale garbage, but listener never ran
+    assert c.backend_available is False
+    enforce_budget(c, builds=None, backend_compiles=0)
+
+
+# ------------------------------------------- acceptance: cache buster
+def test_cache_busting_test_fails_in_subprocess(tmp_path):
+    """A test that builds more executables than its budget must FAIL —
+    run in a real pytest subprocess with the same conftest wiring the
+    suite uses (env staging, then star-import of the plugin)."""
+    (tmp_path / "conftest.py").write_text(textwrap.dedent("""
+        import os
+
+        os.environ["JAX_PLATFORMS"] = "cpu"
+
+        from quiver_tpu.analysis.retrace_guard import *  # noqa: F401,F403
+    """))
+    (tmp_path / "test_bust.py").write_text(textwrap.dedent("""
+        import numpy as np
+        import pytest
+
+        from quiver_tpu import CSRTopo, GraphSageSampler
+
+
+        @pytest.mark.retrace_budget(1)
+        def test_cache_buster():
+            rng = np.random.default_rng(0)
+            src = rng.integers(0, 60, 400)
+            dst = rng.integers(0, 60, 400)
+            topo = CSRTopo(edge_index=np.stack([src, dst]))
+            s = GraphSageSampler(topo, [3, 2])
+            for B in (4, 8, 16):     # 3 distinct shapes, budget is 1
+                s.sample(np.arange(B, dtype=np.int32))
+    """))
+    env = dict(os.environ, PYTHONPATH=str(REPO), JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytest", "test_bust.py", "-q", "-p",
+         "no:cacheprovider"],
+        capture_output=True, text=True, timeout=600, cwd=str(tmp_path),
+        env=env)
+    assert proc.returncode != 0, proc.stdout + proc.stderr
+    assert "retrace budget exceeded" in proc.stdout
+    assert "3 jit build(s) > budget 1" in proc.stdout

@@ -290,23 +290,11 @@ class TestExport:
         finally:
             srv.close()
 
-    def test_hot_paths_never_import_http_exporter(self):
-        """Importing every instrumented module must not pull in
-        quiver_tpu.telemetry.export (and with it http.server as OUR
-        dependency) — the endpoint is opt-in via expose_metrics()."""
-        code = (
-            "import sys\n"
-            "import quiver_tpu, quiver_tpu.serving, quiver_tpu.sampler,"
-            " quiver_tpu.feature, quiver_tpu.uva, quiver_tpu.mixed,"
-            " quiver_tpu.dist.feature, quiver_tpu.dist.sampler, bench\n"
-            "assert 'quiver_tpu.telemetry.export' not in sys.modules,"
-            " 'hot-path module imports the HTTP exporter'\n"
-        )
-        proc = subprocess.run(
-            [sys.executable, "-c", code], capture_output=True, text=True,
-            timeout=300, cwd=str(__import__("pathlib").Path(
-                __file__).resolve().parents[1]))
-        assert proc.returncode == 0, proc.stderr
+    # The old test_hot_paths_never_import_http_exporter subprocess check
+    # is retired: quiverlint QT004 (import-layering) enforces the same
+    # invariant statically over EVERY library module on every lint run —
+    # see quiver_tpu/analysis/rules/qt004_layering.py and
+    # tests/test_lint_clean.py.
 
 
 # ------------------------------------------------------------ wiring
