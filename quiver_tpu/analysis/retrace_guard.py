@@ -160,6 +160,11 @@ def count_jit_builds():
         patch(Feature, "_merge_fn",
               _count_cache_growth(counter, "feature._merge_fn",
                                   "_merge_cache"))
+        # the overlay's admission scatter shares _merge_cache but builds
+        # through its own accessor — count it separately
+        patch(Feature, "_admit_fn",
+              _count_cache_growth(counter, "feature._admit_fn",
+                                  "_merge_cache"))
     except ImportError:
         pass
     try:

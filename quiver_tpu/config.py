@@ -39,6 +39,19 @@ class Config:
     cache_policy: str = field(
         default_factory=lambda: _env("CACHE_POLICY", "device_replicate")
     )
+    # cold-row overlay cache (docs/FEATURE_CACHE.md): "auto" = off until
+    # enable_cold_cache() / the serving auto-enable; "off"/"0" = never;
+    # an explicit size ("64M", or rows under cache_unit="rows") enables
+    # the overlay at feature build time
+    cold_cache_size: str = field(
+        default_factory=lambda: _env("COLD_CACHE_SIZE", "auto")
+    )
+    cold_cache_policy: str = field(
+        default_factory=lambda: _env("COLD_CACHE_POLICY", "clock")
+    )
+    cold_cache_admit: int = field(
+        default_factory=lambda: _env("COLD_CACHE_ADMIT", 2, int)
+    )
     # serving
     serving_buckets: Tuple[int, ...] = (
         8, 16, 32, 64, 128, 256, 512, 1024, 2048

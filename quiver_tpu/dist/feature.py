@@ -17,6 +17,7 @@ shard" is rows ``[p*max_local, (p+1)*max_local)`` — device-local on p.
 
 from __future__ import annotations
 
+import threading
 from typing import Optional
 
 import numpy as np
@@ -107,7 +108,15 @@ class DistFeature:
     feature is available and gets laid out into shards), then index with
     ``dist_feature[ids]`` where ``ids`` is ``[n_hosts, B]`` (one query batch
     per host shard) or ``[B]`` (this host's batch, parity mode).
+
+    :meth:`enable_cold_cache` attaches a per-host HBM overlay in front
+    of the all-to-all: this host's recurring remote rows are served from
+    a local device table instead of round-tripping the collective
+    (``docs/FEATURE_CACHE.md``); the overlay state is guarded by
+    ``_ov_lock`` (quiverlint QT003).
     """
+
+    _guarded_by = {"_overlay": "_ov_lock"}
 
     def __init__(self, mesh: Mesh, info: PartitionInfo, axis: str = "data",
                  request_cap: Optional[int] = None):
@@ -121,6 +130,10 @@ class DistFeature:
         self.g2l = None          # [N] int32 device (local slot incl. replicas)
         self.g2h = None          # [N] int32 device
         self._fn = {}
+        self._host_source = None  # numpy global feature (overlay admission)
+        self.cold_cache = None    # ColdRowCache over global-id space
+        self._overlay = None      # jax.Array [C, D] per-host overlay table
+        self._ov_lock = threading.Lock()
 
     @classmethod
     def from_global_feature(cls, feature: np.ndarray, mesh: Mesh,
@@ -147,6 +160,7 @@ class DistFeature:
         rep_rank = np.zeros(n, dtype=np.int32)
         rep_rank[info.rep_ids] = np.arange(len(info.rep_ids), dtype=np.int32)
         self._rep_rank = rep_rank
+        self._host_source = np.asarray(feature)  # overlay admission source
         sharding = NamedSharding(mesh, P(axis, None, None))
         self.shards = jax.device_put(shards, sharding)
         self.g2l = jnp.asarray(g2l)
@@ -214,6 +228,136 @@ class DistFeature:
         )
         return jax.jit(f)
 
+    # -- per-host cold-row overlay (docs/FEATURE_CACHE.md) -------------
+    def enable_cold_cache(self, rows: Optional[int] = None,
+                          policy: Optional[str] = None,
+                          admit_threshold: Optional[int] = None
+                          ) -> "DistFeature":
+        """Attach a per-host HBM overlay over the remote-row space.
+
+        This host's recurring remote (non-replicated, other-owner) rows
+        are admitted into a local ``[rows, D]`` device table; overlay
+        hits drop out of the all-to-all entirely — their valid bit
+        clears (freeing request-bucket capacity) and the rows come back
+        as a device-side patch after the collective.
+        """
+        assert self._host_source is not None, (
+            "enable_cold_cache needs from_global_feature (the host-side "
+            "source copy feeds admission)"
+        )
+        from ..config import get_config
+        from ..ops.coldcache import ColdRowCache
+
+        cfg = get_config()
+        n, d = self._host_source.shape
+        if rows is None:
+            rows = max(1024, self.info.max_local // 4)
+        rows = int(min(rows, n))
+        policy = policy or cfg.cold_cache_policy
+        admit = (admit_threshold if admit_threshold is not None
+                 else cfg.cold_cache_admit)
+        with self._ov_lock:
+            self.cold_cache = ColdRowCache(rows, n, policy=policy,
+                                           admit_threshold=admit)
+            self._overlay = jnp.zeros(
+                (rows, d), dtype=self._host_source.dtype)
+        return self
+
+    def _ov_patch_fn(self, B, bucket, me):
+        """Cached per-(B, bucket) patch program: scatter overlay hits
+        into this host's output row (pad pos = B, dropped)."""
+        key = ("ov_patch", B, bucket)
+        fn = self._fn.get(key)
+        if fn is None:
+
+            @jax.jit
+            def fn(out, table, slot, pos):
+                rows = jnp.take(table, slot, axis=0)
+                return out.at[me, pos].set(rows, mode="drop")
+
+            self._fn[key] = fn
+        return fn
+
+    def _ov_admit_fn(self, bucket):
+        """Cached per-bucket overlay scatter-update (pad slot =
+        capacity, dropped).  No donation: an earlier patch closure may
+        still hold the previous table value."""
+        key = ("ov_admit", bucket)
+        fn = self._fn.get(key)
+        if fn is None:
+
+            @jax.jit
+            def fn(table, slots, rows):
+                return table.at[slots].set(rows, mode="drop")
+
+            self._fn[key] = fn
+        return fn
+
+    def _overlay_probe(self, ids, valid):
+        """Host-side overlay step for this host's query row.
+
+        Probes the remote non-replicated ids, clears the valid bit of
+        hits (they skip the all-to-all), admits recurring misses from
+        the host source copy, and returns a patch closure applying the
+        hits to the collective's output — or None when nothing hit.
+        Mirrors ``Feature._stage_overlay``'s atomicity: probe + admit +
+        table update + table-value capture all under ``_ov_lock``.
+        """
+        from ..feature import _pow2_bucket
+        from .. import telemetry
+
+        me = self.info.host
+        B = ids.shape[1]
+        row = ids[me]
+        cand = (valid[me] & ~self.info.replicate_mask[row]
+                & (self.info.global2host[row] != me))
+        pos_all = np.nonzero(cand)[0].astype(np.int32)
+        if not len(pos_all):
+            return None
+        gids = row[pos_all].astype(np.int64)
+        n_evicted = 0
+        with self._ov_lock:
+            cache = self.cold_cache
+            hit_mask, slots = cache.probe(gids)
+            n_hit = int(hit_mask.sum())
+            table = self._overlay  # value consistent with the probe
+            miss_ids = gids[~hit_mask]
+            if len(miss_ids):
+                adm, n_evicted = cache.admit(miss_ids)
+                amask = adm >= 0
+                if amask.any():
+                    ba = _pow2_bucket(int(amask.sum()))
+                    adm_slot = np.full(ba, cache.capacity, dtype=np.int32)
+                    adm_slot[: int(amask.sum())] = adm[amask]
+                    rows = np.zeros((ba, self._host_source.shape[1]),
+                                    dtype=self._host_source.dtype)
+                    rows[: int(amask.sum())] = (
+                        self._host_source[miss_ids[amask]]
+                    )
+                    self._overlay = self._ov_admit_fn(ba)(
+                        self._overlay, jnp.asarray(adm_slot),
+                        jnp.asarray(rows))
+        telemetry.counter("dist_feature_coldcache_rows_total",
+                          result="hit").inc(float(n_hit))
+        telemetry.counter("dist_feature_coldcache_rows_total",
+                          result="miss").inc(float(len(gids) - n_hit))
+        if n_evicted:
+            telemetry.counter(
+                "dist_feature_coldcache_evictions_total").inc(
+                float(n_evicted))
+        if n_hit == 0:
+            return None
+        hit_pos = pos_all[hit_mask]
+        valid[me, hit_pos] = False  # hits skip the all-to-all
+        bh = _pow2_bucket(n_hit)
+        ov_slot = np.zeros(bh, dtype=np.int32)
+        ov_slot[:n_hit] = slots[hit_mask]
+        ov_pos = np.full(bh, B, dtype=np.int32)
+        ov_pos[:n_hit] = hit_pos
+        fn = self._ov_patch_fn(B, bh, me)
+        slot_d, pos_d = jnp.asarray(ov_slot), jnp.asarray(ov_pos)
+        return lambda out: fn(out, table, slot_d, pos_d)
+
     def lookup(self, ids, valid=None):
         """``ids``: [n_hosts, B] int32 (one batch per host).  Returns
         [n_hosts, B, D] with each host's features resolved.
@@ -224,6 +368,14 @@ class DistFeature:
         (cap = B, the exact worst case); check :meth:`overflow_stats` when
         running with a reduced cap — training on silently zeroed features
         is the failure mode this guards against."""
+        ov_patch = None
+        if self.cold_cache is not None and not isinstance(ids, jax.Array):
+            # host-side overlay probe needs host ids; device ids would
+            # force a sync here, so they bypass the overlay entirely
+            ids = np.asarray(ids, dtype=np.int32)
+            valid = (np.ones(ids.shape, dtype=bool) if valid is None
+                     else np.array(valid, dtype=bool))  # copy: bits clear
+            ov_patch = self._overlay_probe(ids, valid)
         ids = jnp.asarray(ids, jnp.int32)
         nh, B = ids.shape
         if valid is None:
@@ -238,6 +390,8 @@ class DistFeature:
         out, overflow = self._fn[key](self.shards, ids, valid)
         self.last_overflow = overflow
         self._overflow_recorded = False
+        if ov_patch is not None:
+            out = ov_patch(out)
         return out
 
     def overflow_stats(self):

@@ -84,6 +84,10 @@ class SeedLoader:
             # dispatch the next batch's sample now and start its cold-tier
             # feature prefetch — the host gather for batch i+1 runs while
             # batch i is on the device (Feature.prefetch double-buffering).
+            # With the cold-row overlay enabled this also WARMS it: the
+            # prefetch worker stages through Feature._stage_overlay, so
+            # batch i+1's recurring cold rows are admitted/resident
+            # before __getitem__ consumes the staged batch.
             # n_id stays a device array here: Feature.prefetch materializes
             # it on ITS worker thread, so this thread never blocks on the
             # i+1 sample.

@@ -227,10 +227,32 @@ class InferenceServer:
                      and feature.cache_count >= feature.node_count
                      and getattr(tpu_sampler, "mode", "TPU") == "TPU")
         self._fused = fused
+        if not fused:
+            self._maybe_enable_cold_cache(feature)
         self._fused_fns = {}
         self._lock = threading.Lock()
         self._threads: List[threading.Thread] = []
         self._stopped = threading.Event()
+
+    @staticmethod
+    def _maybe_enable_cold_cache(feature):
+        """Attach the HBM cold-row overlay to budgeted features in the
+        unfused lane: recurring serving requests keep re-touching the
+        same cold rows, which otherwise cross the host link every
+        request (docs/FEATURE_CACHE.md).  Heuristic sizing via
+        ``enable_cold_cache()`` defaults; ``cold_cache_size="off"`` (or
+        ``0``/``none``) in config vetoes."""
+        if (getattr(feature, "node_count", 0) <= 0
+                or feature.cache_count >= feature.node_count
+                or getattr(feature, "cold_cache", None) is not None
+                or not hasattr(feature, "enable_cold_cache")):
+            return
+        from .config import get_config
+
+        if str(get_config().cold_cache_size).lower() in ("0", "off",
+                                                         "none"):
+            return
+        feature.enable_cold_cache()
 
     # -- core per-request paths ---------------------------------------
     def _pad_ids(self, ids: np.ndarray) -> np.ndarray:
