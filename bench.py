@@ -1023,6 +1023,53 @@ def bench_serving(topo, dim, classes, n_requests=300, hidden=128,
     return st
 
 
+def bench_serving_flightrec(topo, dim, classes, n_requests=300,
+                            gather_mode="auto"):
+    """Flight-recorder A/B: the Device-lane replay with per-request
+    tracing live (every request carries a TraceContext, events appended
+    at each stage, tail-retention classify at finish) vs the
+    ``QUIVER_TELEMETRY=off`` fast path (new_trace returns None, event
+    construction is guarded out).  The delta bounds what the recorder
+    costs on the p50/p99 a production lane actually serves.
+    """
+    from quiver_tpu import telemetry
+    from quiver_tpu.telemetry import flightrec
+
+    was_enabled = telemetry.enabled()
+    try:
+        telemetry.set_enabled(True)
+        telemetry.reset()
+        on = bench_serving(topo, dim, classes, n_requests,
+                           mode="Device", gather_mode=gather_mode)
+        retained = len(flightrec.get_recorder().records())
+        telemetry.set_enabled(False)
+        telemetry.reset()
+        off = bench_serving(topo, dim, classes, n_requests,
+                            mode="Device", gather_mode=gather_mode)
+    finally:
+        telemetry.set_enabled(was_enabled)
+        telemetry.reset()
+    base = max(off["p50_ms"], 1e-9)
+    st = dict(
+        recorder_on=dict(p50_ms=on["p50_ms"], p99_ms=on["p99_ms"],
+                         rps=on["rps"]),
+        recorder_off=dict(p50_ms=off["p50_ms"], p99_ms=off["p99_ms"],
+                          rps=off["rps"]),
+        retained_records=retained,
+        p50_overhead_pct=round((on["p50_ms"] - off["p50_ms"])
+                               / base * 100, 2),
+        p99_overhead_pct=round((on["p99_ms"] - off["p99_ms"])
+                               / max(off["p99_ms"], 1e-9) * 100, 2),
+        count=n_requests,
+        gather_mode=on["gather_mode"],
+    )
+    log(f"serving_flightrec: p50 {on['p50_ms']} ms traced vs "
+        f"{off['p50_ms']} ms off ({st['p50_overhead_pct']:+.1f}%), "
+        f"p99 {on['p99_ms']} vs {off['p99_ms']} ms "
+        f"({st['p99_overhead_pct']:+.1f}%), {retained} retained")
+    return st
+
+
 # ---------------------------------------------------------------- main
 def main():
     ap = argparse.ArgumentParser()
@@ -1031,7 +1078,7 @@ def main():
     ap.add_argument("--iters", type=int, default=20)
     ap.add_argument("--sections",
                     default="sampling,feature,feature_coldcache,e2e,"
-                            "serving,quality",
+                            "serving,serving_flightrec,quality",
                     help="comma-separated subset to run")
     ap.add_argument("--ab-dedup", action="store_true",
                     help="also measure dedup='hop' for sampling + e2e")
@@ -1188,6 +1235,12 @@ def main():
                                          n_requests, mode="Auto",
                                          gather_mode=gm))
 
+    def run_flightrec_section(gm):
+        runner.run("serving_flightrec", 900,
+                   lambda: bench_serving_flightrec(topo, feat_dim,
+                                                   classes, n_requests,
+                                                   gather_mode=gm))
+
     # pre-probe pass under the resolved library default: the sections the
     # judge has zero on-chip numbers for land before the probe can eat
     # the window.  If the probe later picks a different winner, the
@@ -1199,6 +1252,8 @@ def main():
         run_e2e_sections(gm_default)
     if "serving" in want:
         run_serving_sections(gm_default)
+    if "serving_flightrec" in want:
+        run_flightrec_section(gm_default)
 
     if "sampling" in want:
         if args.gather_mode or args.small:
@@ -1218,6 +1273,8 @@ def main():
             run_e2e_sections(gm)
         if "serving" in want:
             run_serving_sections(gm)
+        if "serving_flightrec" in want:
+            run_flightrec_section(gm)
         results = []
         for b in batches:
             r = runner.run(

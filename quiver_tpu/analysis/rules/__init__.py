@@ -10,11 +10,12 @@ from .qt002_retrace import RetraceRule
 from .qt003_locks import LockDisciplineRule
 from .qt004_layering import ImportLayeringRule
 from .qt005_hygiene import HygieneRule
+from .qt006_metric_names import MetricNameRule
 
 __all__ = ["all_rules", "RULE_CLASSES"]
 
 RULE_CLASSES = (HostSyncRule, RetraceRule, LockDisciplineRule,
-                ImportLayeringRule, HygieneRule)
+                ImportLayeringRule, HygieneRule, MetricNameRule)
 
 
 def all_rules() -> List[Rule]:

@@ -59,6 +59,30 @@ class Config:
     max_coalesce: int = field(
         default_factory=lambda: _env("MAX_COALESCE", 8, int)
     )
+    # flight recorder (docs/OBSERVABILITY.md): ring-buffer capacity of
+    # retained request records, and the e2e latency above which an
+    # otherwise-healthy request counts as "slow" and is retained
+    flightrec_capacity: int = field(
+        default_factory=lambda: _env("FLIGHTREC_CAPACITY", 256, int)
+    )
+    flightrec_slow_ms: float = field(
+        default_factory=lambda: _env("FLIGHTREC_SLOW_MS", 100.0, float)
+    )
+    # SLO objectives (telemetry.slo): p99 e2e latency ceiling, error
+    # ratio ceiling, coldcache hit-rate floor (0 disables the floor),
+    # and the watchdog evaluation interval
+    slo_p99_ms: float = field(
+        default_factory=lambda: _env("SLO_P99_MS", 250.0, float)
+    )
+    slo_error_ratio: float = field(
+        default_factory=lambda: _env("SLO_ERROR_RATIO", 0.01, float)
+    )
+    slo_coldcache_hit_floor: float = field(
+        default_factory=lambda: _env("SLO_COLDCACHE_HIT_FLOOR", 0.0, float)
+    )
+    slo_interval_s: float = field(
+        default_factory=lambda: _env("SLO_INTERVAL_S", 5.0, float)
+    )
     # tracing
     trace: bool = field(default_factory=lambda: _env("TRACE", False, bool))
 

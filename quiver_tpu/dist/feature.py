@@ -345,6 +345,13 @@ class DistFeature:
             telemetry.counter(
                 "dist_feature_coldcache_evictions_total").inc(
                 float(n_evicted))
+        from ..telemetry import flightrec
+
+        if flightrec.tracing():
+            flightrec.event("dist.exchange", {
+                "probe_hit": int(n_hit),
+                "probe_miss": int(len(gids) - n_hit),
+                "evicted": int(n_evicted)})
         if n_hit == 0:
             return None
         hit_pos = pos_all[hit_mask]
@@ -392,6 +399,12 @@ class DistFeature:
         self._overflow_recorded = False
         if ov_patch is not None:
             out = ov_patch(out)
+        from ..telemetry import flightrec
+
+        if flightrec.tracing():
+            flightrec.event("dist.lookup", {
+                "hosts": int(nh), "batch": int(B),
+                "overlay_patched": ov_patch is not None})
         return out
 
     def overflow_stats(self):

@@ -469,6 +469,11 @@ class Feature:
         # cache hit served from HBM, a "cold" row crosses the host link
         telemetry.counter("feature_rows_total", tier="hot").inc(
             float(len(idx) - n_cold))
+        from .telemetry import flightrec
+
+        if flightrec.tracing():
+            flightrec.event("feature.stage", {
+                "rows_hot": int(len(idx) - n_cold), "rows_cold": int(n_cold)})
         if n_cold:
             telemetry.counter("feature_rows_total", tier="cold").inc(
                 float(n_cold))
@@ -504,6 +509,11 @@ class Feature:
             buf[:n_rows] = self.cold[rel_ids]
             rows_d = jnp.array(buf)
         telemetry.counter("feature_h2d_bytes_total").inc(float(buf.nbytes))
+        from .telemetry import flightrec
+
+        if flightrec.tracing():
+            flightrec.event("feature.h2d", {"bytes": int(buf.nbytes),
+                                            "rows": int(n_rows)})
         return rows_d
 
     def _stage_overlay(self, idx, jax, jnp, telemetry):
@@ -589,6 +599,14 @@ class Feature:
         if h2d_bytes:
             telemetry.counter("feature_h2d_bytes_total").inc(
                 float(h2d_bytes))
+        from .telemetry import flightrec
+
+        if flightrec.tracing():
+            # per-request attribution of the aggregate coldcache
+            # counters above — which requests are paying the host link
+            flightrec.event("feature.coldcache", {
+                "hit": int(n_hit), "miss": int(n_fresh),
+                "evicted": int(n_evicted), "h2d_bytes": int(h2d_bytes)})
         return ("ov", hot_idx, bc, cold_pos_d, rows_d,
                 bh, ov_slot_d, ov_pos_d, ov_table)
 
@@ -698,11 +716,23 @@ class Feature:
                             cancel_futures=True)
             self._inflight = collections.deque()
 
+        from .telemetry import flightrec
+
+        # capture the caller's trace contexts at submit time: the pool
+        # worker does not inherit contextvars, and re-activating inside
+        # work() attributes the staged gather (coldcache probes, H2D) to
+        # the originating request instead of to an anonymous thread
+        ctxs = flightrec.active()
+
         def work():
             # materialize here (may block on the device sample that
             # produced node_idx) so the CALLER never does
-            idx = np.asarray(node_idx)
-            staged = self._stage(idx)
+            with flightrec.activate(ctxs):
+                idx = np.asarray(node_idx)
+                if flightrec.tracing():
+                    flightrec.event("feature.prefetch",
+                                    {"rows": int(len(idx))})
+                staged = self._stage(idx)
             with self._plock:
                 self._pending[idx.tobytes()] = staged
                 while len(self._pending) > 8:  # drop oldest unclaimed

@@ -78,6 +78,13 @@ class SeedLoader:
         B = self.batch_size
         e = self._epoch  # keyed by epoch: a straggler worker from an
         # abandoned epoch can't feed its stale batch to the next one
+        from .telemetry import flightrec
+
+        if flightrec.tracing():
+            # runs on the Prefetcher worker when prefetch > 0 (the
+            # Prefetcher carries the consumer's context across), so the
+            # event's thread field attributes loader-side work correctly
+            flightrec.event("loader.batch", {"index": int(i)})
         got = self._lookahead.pop((e, i), None)
         seeds, valid, batch = got if got is not None else self._sample(i)
         if i + 1 < len(self):

@@ -11,6 +11,7 @@ queue, so the accelerator never waits on the host.
 
 from __future__ import annotations
 
+import contextvars
 import queue
 import threading
 from typing import Callable, Iterable, Iterator
@@ -39,6 +40,11 @@ class Prefetcher:
         q: "queue.Queue" = queue.Queue(maxsize=self.depth)
         exc = []
         stop = threading.Event()
+        # snapshot the consumer's context (flight-recorder trace, etc.)
+        # so worker-side batch building attributes to whoever started
+        # the iteration — threads do not inherit contextvars.  Sequential
+        # cvctx.run calls are safe: one worker, one context.
+        cvctx = contextvars.copy_context()
 
         def _put_interruptible(item) -> bool:
             # a consumer that abandons iteration early (break / exception)
@@ -57,7 +63,7 @@ class Prefetcher:
                 for it in self.items:
                     if stop.is_set():
                         return
-                    if not _put_interruptible(self.make_batch(it)):
+                    if not _put_interruptible(cvctx.run(self.make_batch, it)):
                         return
             except BaseException as e:  # surface on the consumer side
                 exc.append(e)

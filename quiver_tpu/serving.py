@@ -28,6 +28,7 @@ from typing import Callable, List, Optional, Sequence
 import numpy as np
 
 from . import telemetry
+from .telemetry import flightrec
 
 __all__ = [
     "RequestBatcher", "HybridSampler", "InferenceServer",
@@ -43,6 +44,17 @@ class ServingRequest:
     client: int
     seq: int
     t_enqueue: float = field(default_factory=time.perf_counter)
+    # flight-recorder trace context; None when telemetry is off (every
+    # consumer guards, so the None threads through the pipeline for free)
+    trace: Optional[object] = None
+
+    def __post_init__(self):
+        if self.trace is None:
+            self.trace = flightrec.new_trace()
+            if self.trace is not None:
+                self.trace.add("enqueue", {"n_ids": int(len(self.ids)),
+                                           "client": self.client,
+                                           "seq": self.seq})
 
 
 def _next_bucket(n: int, buckets: Sequence[int]) -> int:
@@ -80,11 +92,11 @@ class RequestBatcher:
 
     def _route(self, req: ServingRequest):
         if self.mode == "CPU":
-            self.cpu_batched_queue.put(req)
+            self._put(self.cpu_batched_queue, req, "cpu")
         elif self.mode == "Device":
-            self.device_batched_queue.put(req)
+            self._put(self.device_batched_queue, req, "device")
         elif self.mode == "Preparation":
-            self.cpu_batched_queue.put(req)
+            self._put(self.cpu_batched_queue, req, "both")
             self.device_batched_queue.put(req)
         else:
             load = (
@@ -92,9 +104,19 @@ class RequestBatcher:
                 if self.neighbour_num is not None else float("inf")
             )
             if load <= self.threshold:
-                self.cpu_batched_queue.put(req)
+                self._put(self.cpu_batched_queue, req, "cpu", load)
             else:
-                self.device_batched_queue.put(req)
+                self._put(self.device_batched_queue, req, "device", load)
+
+    @staticmethod
+    def _put(q: "queue.Queue", req: ServingRequest, lane: str,
+             load: Optional[float] = None):
+        if req.trace is not None:
+            attrs = {"lane": lane}
+            if load is not None and load != float("inf"):
+                attrs["load"] = load
+            req.trace.add("route", attrs)
+        q.put(req)
 
     def _worker(self, q: "queue.Queue"):
         while True:
@@ -138,11 +160,17 @@ class HybridSampler:
     """
 
     def __init__(self, cpu_sampler, cpu_batched_queue: "queue.Queue",
-                 num_workers: int = 2, buckets: Optional[Sequence] = None):
+                 num_workers: int = 2, buckets: Optional[Sequence] = None,
+                 feature=None):
         self.sampler = cpu_sampler
         self.inq = cpu_batched_queue
         self.sampled_queue: "queue.Queue" = queue.Queue()
         self.num_workers = num_workers
+        # optional lookahead: stage the sampled batch's feature rows on
+        # the prefetch pool while the item waits for the CPU-lane server
+        # thread — overlaps H2D with queue time, and the prefetch worker
+        # attributes its work to this request's trace
+        self.feature = feature
         if buckets is None:
             from .config import get_config
 
@@ -165,8 +193,15 @@ class HybridSampler:
                 self.inq.put(_STOP)  # let siblings see it too
                 break
             t0 = time.perf_counter()
-            batch = self.sampler.sample(self._pad(np.asarray(item.ids)))
-            self.sampled_queue.put((item, batch, time.perf_counter() - t0))
+            with flightrec.activate(item.trace):
+                batch = self.sampler.sample(self._pad(np.asarray(item.ids)))
+                dt = time.perf_counter() - t0
+                if flightrec.tracing():
+                    flightrec.event("sample", {
+                        "seconds": dt, "n_id": int(batch.n_id.shape[0])})
+                if self.feature is not None:
+                    self.feature.prefetch(batch.n_id)
+            self.sampled_queue.put((item, batch, dt))
 
     def start(self):
         for _ in range(self.num_workers):
@@ -291,8 +326,11 @@ class InferenceServer:
                 out = self._fused_forward(padded)
                 outs.append(np.asarray(out)[: len(chunk)])
                 if stages is not None:  # one jit: stages are fused too
-                    stages["infer"] = (stages.get("infer", 0.0)
-                                       + time.perf_counter() - t0)
+                    dt = time.perf_counter() - t0
+                    stages["infer"] = stages.get("infer", 0.0) + dt
+                    if flightrec.tracing():
+                        flightrec.event("infer", {"seconds": dt,
+                                                  "fused": True})
             else:
                 t0 = time.perf_counter()
                 batch = self.sampler.sample(padded)
@@ -306,6 +344,10 @@ class InferenceServer:
                     stages["sample"] = stages.get("sample", 0.0) + t1 - t0
                     stages["gather"] = stages.get("gather", 0.0) + t2 - t1
                     stages["infer"] = stages.get("infer", 0.0) + t3 - t2
+                    if flightrec.tracing():
+                        flightrec.event("sample", {"seconds": t1 - t0})
+                        flightrec.event("gather", {"seconds": t2 - t1})
+                        flightrec.event("infer", {"seconds": t3 - t2})
         return outs[0] if len(outs) == 1 else np.concatenate(outs)
 
     def _fused_forward(self, padded_ids: np.ndarray):
@@ -372,6 +414,9 @@ class InferenceServer:
         if stages is not None:
             stages["gather"] = stages.get("gather", 0.0) + t1 - t0
             stages["infer"] = stages.get("infer", 0.0) + t2 - t1
+            if flightrec.tracing():
+                flightrec.event("gather", {"seconds": t1 - t0})
+                flightrec.event("infer", {"seconds": t2 - t1})
         return out
 
     def _drain_coalesce(self, first: ServingRequest):
@@ -423,8 +468,18 @@ class InferenceServer:
             # (queue_wait + stages) still partition end-to-end latency
             t_deq = time.perf_counter()
             stages: dict = {}
+            # a coalesced batch activates EVERY member's trace: they all
+            # wait for this device pass, so they all own its events
+            # (trace is None for all members when telemetry is off, and
+            # activate(None) is the shared no-op)
+            act = (flightrec.activate([r.trace for r in reqs])
+                   if reqs[0].trace is not None else flightrec.activate(None))
             try:
-                outs = self._infer_coalesced(reqs, stages)
+                with act:
+                    if flightrec.tracing():
+                        flightrec.event("dequeue",
+                                        {"coalesced": len(reqs)})
+                    outs = self._infer_coalesced(reqs, stages)
                 t_done = time.perf_counter()
                 for r, o in zip(reqs, outs):
                     self._finish(r, o, lane="device", stages=stages,
@@ -433,6 +488,7 @@ class InferenceServer:
                 for r in reqs:
                     telemetry.counter("serving_requests_total",
                                       lane="device", status="error").inc()
+                    self._finish_error(r, e, lane="device")
                     self.result_queue.put((r, e))
 
     def _cpu_loop(self):
@@ -443,13 +499,15 @@ class InferenceServer:
             req, batch, sample_dt = item
             stages = {"sample": float(sample_dt)}
             try:
-                out = self._infer_presampled(req, batch, stages)
+                with flightrec.activate(req.trace):
+                    out = self._infer_presampled(req, batch, stages)
                 t_done = time.perf_counter()
                 self._finish(req, out, lane="cpu", stages=stages,
                              t_done=t_done)
             except Exception as e:  # noqa: BLE001 — lane must survive
                 telemetry.counter("serving_requests_total",
                                   lane="cpu", status="error").inc()
+                self._finish_error(req, e, lane="cpu")
                 self.result_queue.put((req, e))
 
     def _finish(self, req, out, lane: str = "device",
@@ -458,6 +516,16 @@ class InferenceServer:
                 t_done: Optional[float] = None):
         self._record_request(req, lane, stages or {}, t_dequeue, t_done)
         self.result_queue.put((req, out))
+
+    def _finish_error(self, req, exc, lane: str):
+        """Error-path retention: a failed request is always kept by the
+        flight recorder (reason=error), with the exception on its log."""
+        tr = getattr(req, "trace", None)
+        if tr is None:
+            return
+        tr.add("error", {"type": type(exc).__name__, "message": str(exc)})
+        e2e = max(time.perf_counter() - req.t_enqueue, 0.0)
+        flightrec.get_recorder().finish(tr, e2e, status="error", lane=lane)
 
     def _record_request(self, req, lane, stages, t_dequeue, t_done):
         """Fold one served request into the registry.  Returns
@@ -483,6 +551,11 @@ class InferenceServer:
         for stage, dt in full.items():
             telemetry.histogram("serving_stage_seconds", lane=lane,
                                 stage=stage).observe(dt)
+        tr = getattr(req, "trace", None)
+        if tr is not None:
+            tr.add("finish", {"lane": lane})
+            flightrec.get_recorder().finish(tr, e2e, status="ok", lane=lane,
+                                            stages=full)
         return e2e, full
 
     def expose_metrics(self, port: int = 0, host: str = "127.0.0.1"):
@@ -493,6 +566,16 @@ class InferenceServer:
 
         self._metrics_server = start_http_server(port=port, host=host)
         return self._metrics_server
+
+    def start_slo_watchdog(self):
+        """Start the process-wide SLO watchdog thread (objectives from
+        config).  Explicit by design: a background evaluator should not
+        appear as a side effect of constructing a server.  Stopped with
+        the server."""
+        from .telemetry.slo import get_watchdog
+
+        self._slo_watchdog = get_watchdog().start()
+        return self._slo_watchdog
 
     def start(self):
         t = threading.Thread(target=self._device_loop, daemon=True)
@@ -515,6 +598,10 @@ class InferenceServer:
         if srv is not None:
             srv.close()
             self._metrics_server = None
+        wd = getattr(self, "_slo_watchdog", None)
+        if wd is not None:
+            wd.stop()
+            self._slo_watchdog = None
 
 
 def calibrate_threshold(tpu_sampler, cpu_sampler, feature, apply_fn, params,
@@ -626,6 +713,11 @@ class InferenceServer_Debug(InferenceServer):
                 acc[0] += 1
                 acc[1] += dt
         return e2e, full
+
+    def flight_records(self) -> list:
+        """Retained flight-recorder records (oldest first) — the tail
+        of requests worth debugging: slow, errored, or flagged."""
+        return flightrec.get_recorder().records()
 
     def stats(self) -> dict:
         with self._lock:

@@ -70,18 +70,22 @@ def get_tracer() -> SpanTracer:
     return _tracer if _ENABLED else _noop.TRACER
 
 
-def counter(name: str, **labels) -> Counter:
-    return _registry.counter(name, **labels) if _ENABLED else _noop.METRIC
+def counter(name: str, help: Optional[str] = None, **labels) -> Counter:
+    if _ENABLED:
+        return _registry.counter(name, help=help, **labels)
+    return _noop.METRIC
 
 
-def gauge(name: str, **labels) -> Gauge:
-    return _registry.gauge(name, **labels) if _ENABLED else _noop.METRIC
+def gauge(name: str, help: Optional[str] = None, **labels) -> Gauge:
+    if _ENABLED:
+        return _registry.gauge(name, help=help, **labels)
+    return _noop.METRIC
 
 
 def histogram(name: str, bounds: Optional[Sequence[float]] = None,
-              **labels) -> Histogram:
+              help: Optional[str] = None, **labels) -> Histogram:
     if _ENABLED:
-        return _registry.histogram(name, bounds=bounds, **labels)
+        return _registry.histogram(name, bounds=bounds, help=help, **labels)
     return _noop.METRIC
 
 
@@ -102,3 +106,13 @@ def merge(snap: dict) -> None:
 def reset() -> None:
     _registry.reset()
     _tracer.reset()
+    # Companion singletons (lazy submodules — never imported just to
+    # reset them if nothing ever touched them).
+    import sys
+
+    fr = sys.modules.get(__name__ + ".flightrec")
+    if fr is not None:
+        fr.reset()
+    slo = sys.modules.get(__name__ + ".slo")
+    if slo is not None:
+        slo.reset()

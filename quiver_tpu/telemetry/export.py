@@ -11,8 +11,12 @@ Three views:
     (counters, gauges, and cumulative ``_bucket{le=...}`` histograms).
   * ``to_json(snapshot)`` — the snapshot itself, serialized.
   * ``start_http_server()`` — a daemon-threaded stdlib server exposing
-    ``/metrics`` (text), ``/metrics.json``, and ``/trace.json`` (Chrome
-    trace events, Perfetto-loadable).
+    ``/metrics`` (text), ``/metrics.json``, ``/trace.json`` (Chrome
+    trace events, Perfetto-loadable), plus the flight-recorder debug
+    surface: ``/debug/requests`` (retained-request summaries),
+    ``/debug/requests/<trace_id>`` (one full event log), and
+    ``/debug/slo`` (watchdog objective status).  ``HEAD`` answers every
+    route with the headers its ``GET`` would carry.
 """
 
 from __future__ import annotations
@@ -44,14 +48,24 @@ def _fmt_num(v: float) -> str:
     return repr(float(v))
 
 
+def _escape_help(text: str) -> str:
+    # Prometheus text format: backslash and newline are the only escapes
+    # in HELP text.
+    return text.replace("\\", "\\\\").replace("\n", "\\n")
+
+
 def to_prometheus_text(snapshot: dict) -> str:
     """Prometheus text exposition of a registry snapshot."""
     lines = []
     typed = set()
+    help_texts = snapshot.get("help", {})
 
     def _type(name: str, kind: str) -> None:
         if name not in typed:
             typed.add(name)
+            text = help_texts.get(name)
+            if text:
+                lines.append(f"# HELP {name} {_escape_help(text)}")
             lines.append(f"# TYPE {name} {kind}")
 
     for key, v in sorted(snapshot.get("counters", {}).items()):
@@ -97,29 +111,67 @@ class MetricsServer:
         outer = self
 
         class _Handler(BaseHTTPRequestHandler):
-            def do_GET(self):  # noqa: N802 (stdlib API name)
+            def _payload(self):
+                """Route ``self.path`` -> ``(body, ctype)`` or ``None``
+                for a 404.  Shared by GET and HEAD so HEAD answers with
+                the exact headers a GET would carry."""
+                path = self.path
+                if path.startswith("/metrics.json"):
+                    return (to_json(outer.registry.snapshot(), indent=2),
+                            "application/json")
+                if path.startswith("/metrics"):
+                    return (to_prometheus_text(outer.registry.snapshot()),
+                            "text/plain; version=0.0.4")
+                if path.startswith("/trace.json"):
+                    return (json.dumps(outer.tracer.chrome_trace()),
+                            "application/json")
+                if path.startswith("/debug/requests"):
+                    from .flightrec import get_recorder
+
+                    rec = get_recorder()
+                    parts = path.rstrip("/").split("/")
+                    if len(parts) >= 4 and parts[3]:
+                        record = rec.get(parts[3])
+                        if record is None:
+                            return None
+                        return json.dumps(record, indent=2), "application/json"
+                    body = json.dumps({
+                        "capacity": rec.capacity,
+                        "slow_threshold_s": rec.slow_threshold_s,
+                        "count": len(rec.records()),
+                        "records": rec.summaries(),
+                    }, indent=2)
+                    return body, "application/json"
+                if path.startswith("/debug/slo"):
+                    from .slo import get_watchdog
+
+                    return (json.dumps(get_watchdog().status(), indent=2),
+                            "application/json")
+                return None
+
+            def _respond(self, send_body: bool) -> None:
                 try:
-                    if self.path.startswith("/metrics.json"):
-                        body = to_json(outer.registry.snapshot(), indent=2)
-                        ctype = "application/json"
-                    elif self.path.startswith("/metrics"):
-                        body = to_prometheus_text(outer.registry.snapshot())
-                        ctype = "text/plain; version=0.0.4"
-                    elif self.path.startswith("/trace.json"):
-                        body = json.dumps(outer.tracer.chrome_trace())
-                        ctype = "application/json"
-                    else:
-                        self.send_error(404)
-                        return
+                    payload = self._payload()
                 except Exception as e:  # pragma: no cover - defensive
                     self.send_error(500, str(e))
                     return
+                if payload is None:
+                    self.send_error(404)
+                    return
+                body, ctype = payload
                 data = body.encode()
                 self.send_response(200)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(data)))
                 self.end_headers()
-                self.wfile.write(data)
+                if send_body:
+                    self.wfile.write(data)
+
+            def do_GET(self):  # noqa: N802 (stdlib API name)
+                self._respond(send_body=True)
+
+            def do_HEAD(self):  # noqa: N802 (stdlib API name)
+                self._respond(send_body=False)
 
             def log_message(self, *a):  # silence per-request stderr spam
                 pass

@@ -60,13 +60,13 @@ class _NoopMetric:
 class _NoopRegistry:
     __slots__ = ()
 
-    def counter(self, name, **labels):
+    def counter(self, name, help=None, **labels):
         return METRIC
 
-    def gauge(self, name, **labels):
+    def gauge(self, name, help=None, **labels):
         return METRIC
 
-    def histogram(self, name, bounds=None, **labels):
+    def histogram(self, name, bounds=None, help=None, **labels):
         return METRIC
 
     def snapshot(self) -> dict:

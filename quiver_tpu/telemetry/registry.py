@@ -26,6 +26,7 @@ contain ``,``, ``=``, or ``}`` (enforced at creation).
 from __future__ import annotations
 
 import bisect
+import math
 import threading
 import time
 from typing import Dict, Iterator, Optional, Sequence, Tuple
@@ -178,6 +179,15 @@ class Histogram:
         total = sum(counts)
         if not total:
             return 0.0
+        # A histogram populated purely via merge_dict may lack observed
+        # min/max (older snapshots, or deltas that dropped them): the
+        # sentinels are +/-inf and would leak straight through the clamp
+        # below.  Fall back to the finite bucket grid — values at or
+        # beyond the last bound report the last finite bound, never inf.
+        if not math.isfinite(hi_obs):
+            hi_obs = self.bounds[-1]
+        if not math.isfinite(lo_obs):
+            lo_obs = self.bounds[0]
         target = max(q, 0.0) / 100.0 * total
         cum = 0.0
         for i, c in enumerate(counts):
@@ -240,18 +250,24 @@ class MetricsRegistry:
     unlocked ``.get()`` in ``_get`` is the double-checked fast path).
     """
 
-    _guarded_by = {"_metrics": "_lock"}
+    _guarded_by = {"_metrics": "_lock", "_help": "_lock"}
 
     def __init__(self):
         self._lock = threading.Lock()
         self._metrics: Dict[str, object] = {}
+        # metric *family* name -> help text (one line per family in the
+        # Prometheus exposition, regardless of label instances).
+        self._help: Dict[str, str] = {}
 
     # -- handle accessors -------------------------------------------------
-    def _get(self, cls, name: str, labels: dict, **kw):
+    def _get(self, cls, name: str, labels: dict, help: Optional[str] = None,
+             **kw):
         key = metric_key(name, labels)
         m = self._metrics.get(key)
-        if m is None:
+        if m is None or (help is not None and name not in self._help):
             with self._lock:
+                if help is not None:
+                    self._help.setdefault(name, str(help))
                 m = self._metrics.get(key)
                 if m is None:
                     m = cls(key, **kw)
@@ -262,16 +278,19 @@ class MetricsRegistry:
                             f"{cls.__name__}")
         return m
 
-    def counter(self, name: str, **labels) -> Counter:
-        return self._get(Counter, name, labels)
+    def counter(self, name: str, help: Optional[str] = None,
+                **labels) -> Counter:
+        return self._get(Counter, name, labels, help=help)
 
-    def gauge(self, name: str, **labels) -> Gauge:
-        return self._get(Gauge, name, labels)
+    def gauge(self, name: str, help: Optional[str] = None,
+              **labels) -> Gauge:
+        return self._get(Gauge, name, labels, help=help)
 
     def histogram(self, name: str,
                   bounds: Optional[Sequence[float]] = None,
+                  help: Optional[str] = None,
                   **labels) -> Histogram:
-        return self._get(Histogram, name, labels, bounds=bounds)
+        return self._get(Histogram, name, labels, help=help, bounds=bounds)
 
     def __iter__(self) -> Iterator[Tuple[str, object]]:
         with self._lock:
@@ -290,6 +309,12 @@ class MetricsRegistry:
                 out["gauges"][key] = m.value
             elif isinstance(m, Histogram):
                 out["histograms"][key] = m.to_dict()
+        with self._lock:
+            if self._help:
+                # Only when non-empty: snapshots without help text keep
+                # their historical exact shape (and snapshot_delta
+                # equality against {} still holds).
+                out["help"] = dict(self._help)
         return out
 
     def merge(self, snap: dict) -> None:
@@ -303,10 +328,16 @@ class MetricsRegistry:
         for key, d in snap.get("histograms", {}).items():
             name, labels = parse_metric_key(key)
             self.histogram(name, bounds=d["bounds"], **labels).merge_dict(d)
+        h = snap.get("help")
+        if h:
+            with self._lock:
+                for name, text in h.items():
+                    self._help.setdefault(name, text)
 
     def reset(self) -> None:
         with self._lock:
             self._metrics.clear()
+            self._help.clear()
 
 
 def snapshot_delta(before: dict, after: dict) -> dict:

@@ -1,0 +1,512 @@
+"""Flight recorder + SLO watchdog tests.
+
+Covers the tentpole acceptance criteria: a slow request (injected sleep
+in the CPU lane) is retained with a complete, ordered event log whose
+stage intervals partition the end-to-end latency — including at least
+one event attributed from the feature-prefetch worker thread — while a
+fast request is discarded; /debug/requests and /debug/slo round-trip
+JSON over HTTP; steady-state serving replay with tracing active builds
+zero new jit executables.
+"""
+
+import json
+import queue
+import threading
+import time
+
+import numpy as np
+import jax
+import pytest
+
+import quiver_tpu.config as config_mod
+from quiver_tpu import (
+    Feature, GraphSageSampler, HybridSampler, InferenceServer,
+    InferenceServer_Debug, RequestBatcher, SeedLoader, telemetry,
+)
+from quiver_tpu.analysis.retrace_guard import count_jit_builds
+from quiver_tpu.models import GraphSAGE
+from quiver_tpu.serving import ServingRequest
+from quiver_tpu.telemetry import flightrec
+from quiver_tpu.telemetry.flightrec import (
+    FlightRecorder, TraceContext, partition_check,
+)
+from quiver_tpu.telemetry.slo import SLOWatchdog, get_watchdog
+
+pytestmark = pytest.mark.telemetry
+
+_CFG_FIELDS = ("flightrec_capacity", "flightrec_slow_ms", "slo_p99_ms",
+               "slo_error_ratio", "slo_coldcache_hit_floor",
+               "slo_interval_s")
+
+
+@pytest.fixture(autouse=True)
+def _clean_flightrec():
+    """Fresh recorder/watchdog/registry per test; config restored after.
+
+    ``telemetry.reset()`` drops the flightrec + slo singletons, so a
+    test that tweaks config just resets and touches them again."""
+    cfg = config_mod.get_config()
+    saved = {k: getattr(cfg, k) for k in _CFG_FIELDS}
+    telemetry.set_enabled(True)
+    telemetry.reset()
+    yield
+    config_mod.update(**saved)
+    telemetry.set_enabled(True)
+    telemetry.reset()
+
+
+# ===================================================== unit: TraceContext
+def test_trace_event_log_is_monotonic_and_thread_stamped():
+    tr = flightrec.new_trace()
+    tr.add("enqueue", {"n_ids": 3})
+    with flightrec.activate(tr):
+        assert flightrec.tracing()
+        flightrec.event("sample", {"seconds": 0.01})
+
+    done = threading.Event()
+
+    def worker():
+        with flightrec.activate(tr):
+            flightrec.event("gather")
+        done.set()
+
+    threading.Thread(target=worker, name="stager-0").start()
+    assert done.wait(5)
+    tr.add("finish")
+    rec = tr.to_record(0.5, lane="cpu", stages={"sample": 0.5})
+    names = [e["name"] for e in rec["events"]]
+    assert names == ["enqueue", "sample", "gather", "finish"]
+    ts = [e["t"] for e in rec["events"]]
+    assert ts == sorted(ts) and all(t >= 0 for t in ts)
+    threads = {e["name"]: e["thread"] for e in rec["events"]}
+    assert threads["gather"] == "stager-0"
+    assert rec["events"][0]["attrs"] == {"n_ids": 3}
+
+
+def test_event_cap_counts_drops():
+    tr = TraceContext()
+    for i in range(flightrec._MAX_EVENTS_PER_TRACE + 5):
+        tr.add("e")
+    rec = tr.to_record(0.0)
+    assert len(rec["events"]) == flightrec._MAX_EVENTS_PER_TRACE
+    assert rec["events_dropped"] == 5
+
+
+def test_coalesced_activation_fans_out_to_all_members():
+    trs = [TraceContext() for _ in range(3)]
+    with flightrec.activate(trs):
+        flightrec.event("dequeue", {"coalesced": 3})
+    for tr in trs:
+        assert [n for _, n, _, _ in tr.events] == ["dequeue"]
+
+
+def test_disabled_is_zero_allocation():
+    telemetry.set_enabled(False)
+    assert flightrec.new_trace() is None
+    assert flightrec.activate(None) is flightrec._NOOP_ACTIVATION
+    assert flightrec.activate([None, None]) is flightrec._NOOP_ACTIVATION
+    with flightrec.activate(None):
+        assert not flightrec.tracing()
+        flightrec.event("ignored")  # must not raise
+    assert flightrec.get_recorder().finish(None, 1.0) is None
+
+
+# ===================================================== unit: recorder
+def test_classify_precedence_error_flagged_slow():
+    rec = FlightRecorder(capacity=8, slow_threshold_s=0.1)
+    tr = TraceContext()
+    tr.flag()
+    assert rec.classify(tr, 5.0, "error") == "error"
+    assert rec.classify(tr, 5.0, "ok") == "flagged"
+    assert rec.classify(TraceContext(), 5.0, "ok") == "slow"
+    assert rec.classify(TraceContext(), 0.01, "ok") is None
+
+
+def test_ring_eviction_and_lookup():
+    rec = FlightRecorder(capacity=2, slow_threshold_s=0.0)
+    ids = []
+    for _ in range(3):
+        tr = TraceContext()
+        tr.add("enqueue")
+        rec.finish(tr, 1.0, lane="cpu")
+        ids.append(tr.trace_id)
+    got = rec.records()
+    assert [r["trace_id"] for r in got] == ids[1:]  # oldest evicted
+    assert rec.get(ids[0]) is None
+    assert rec.get(ids[2])["reason"] == "slow"
+    summaries = rec.summaries()
+    assert [s["trace_id"] for s in summaries] == ids[1:]
+    assert summaries[0]["e2e_ms"] == 1000.0
+    rec.reset()
+    assert rec.records() == []
+
+
+def test_retention_counters_tick():
+    rec = FlightRecorder(capacity=4, slow_threshold_s=0.1)
+    rec.finish(TraceContext(), 1.0)         # slow
+    flagged = TraceContext()
+    flagged.flag()
+    rec.finish(flagged, 0.0)                # flagged
+    rec.finish(TraceContext(), 0.0, status="error")
+    rec.finish(TraceContext(), 0.0)         # dropped
+    snap = telemetry.get_registry().snapshot()
+    c = snap["counters"]
+    assert c['flightrec_retained_total{reason=slow}'] == 1
+    assert c['flightrec_retained_total{reason=flagged}'] == 1
+    assert c['flightrec_retained_total{reason=error}'] == 1
+    assert c['flightrec_dropped_total'] == 1
+
+
+def test_partition_check():
+    good = {"e2e_seconds": 1.0,
+            "stages": {"queue_wait": 0.4, "sample": 0.35, "infer": 0.24}}
+    bad = {"e2e_seconds": 1.0, "stages": {"sample": 0.1}}
+    assert partition_check(good)
+    assert not partition_check(bad)
+    assert not partition_check({"e2e_seconds": 1.0})
+
+
+# ===================================================== serving acceptance
+class _SlowSampler:
+    """CPU-lane sampler wrapper with a togglable injected stall."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.sleep_s = 0.0
+
+    def sample(self, seeds, key=None):
+        if self.sleep_s:
+            time.sleep(self.sleep_s)
+        return self.inner.sample(seeds)
+
+
+def _cpu_stack(small_graph, rng, dim=8, cache="2K", apply_fn=None):
+    """CPU-lane serving stack with a budgeted feature so the
+    HybridSampler lookahead actually stages rows on the prefetch pool."""
+    n = small_graph.node_count
+    feat = rng.normal(size=(n, dim)).astype(np.float32)
+    feature = Feature(device_cache_size=cache).from_cpu_tensor(feat)
+    sizes = [4, 3]
+    tpu_sampler = GraphSageSampler(small_graph, sizes)
+    slow = _SlowSampler(GraphSageSampler(small_graph, sizes, mode="CPU"))
+    if apply_fn is None:
+        model = GraphSAGE(hidden=16, out_dim=3, num_layers=2, dropout=0.0)
+        b0 = tpu_sampler.sample(np.arange(4, dtype=np.int64))
+        params = model.init(jax.random.PRNGKey(0),
+                            feature[np.asarray(b0.n_id)], b0.layers)
+        apply_fn = jax.jit(lambda p, x, blocks: model.apply(p, x, blocks))
+    else:
+        params = None
+    stream = queue.Queue()
+    rb = RequestBatcher([stream], mode="CPU").start()
+    hs = HybridSampler(slow, rb.cpu_batched_queue, num_workers=1,
+                       buckets=(4, 8, 16), feature=feature).start()
+    server = InferenceServer_Debug(
+        tpu_sampler, feature, apply_fn, params,
+        rb.device_batched_queue, hs.sampled_queue, fused=False)
+    server.BUCKETS = (4, 8, 16)
+    server.start()
+    return stream, rb, hs, server, slow
+
+
+def _serve_one(stream, server, ids, seq):
+    req = ServingRequest(ids=np.asarray(ids, dtype=np.int64),
+                         client=0, seq=seq)
+    stream.put(req)
+    got_req, out = server.result_queue.get(timeout=60)
+    assert got_req.seq == seq
+    return req, out
+
+
+def test_slow_request_retained_fast_discarded(small_graph, rng):
+    config_mod.update(flightrec_slow_ms=250.0)
+    telemetry.reset()  # recorder re-reads the lowered threshold
+    stream, rb, hs, server, slow = _cpu_stack(small_graph, rng)
+    try:
+        # warm the CPU-lane compile path so the "fast" request really is
+        _serve_one(stream, server, [1, 2, 3], seq=0)
+        flightrec.get_recorder().reset()
+
+        slow.sleep_s = 0.6
+        slow_req, _ = _serve_one(stream, server, [4, 5, 6], seq=1)
+        slow.sleep_s = 0.0
+        fast_req, _ = _serve_one(stream, server, [7, 8, 9], seq=2)
+        # let the recorder see both finishes before asserting
+        deadline = time.time() + 5
+        while not server.flight_records() and time.time() < deadline:
+            time.sleep(0.01)
+
+        records = server.flight_records()
+        assert [r["trace_id"] for r in records] == [slow_req.trace.trace_id]
+        rec = records[0]
+        assert rec["status"] == "ok"
+        assert rec["reason"] == "slow"
+        assert rec["lane"] == "cpu"
+        assert flightrec.get_recorder().get(fast_req.trace.trace_id) is None
+
+        names = [e["name"] for e in rec["events"]]
+        for expected in ("enqueue", "route", "sample", "gather", "infer",
+                         "finish"):
+            assert expected in names, f"missing {expected} in {names}"
+        assert names[0] == "enqueue" and names[-1] == "finish"
+        ts = [e["t"] for e in rec["events"]]
+        assert ts == sorted(ts)
+
+        # cross-thread attribution: the lookahead staging ran on the
+        # feature-prefetch pool under this request's context
+        threads = {e["thread"] for e in rec["events"]}
+        assert any(t.startswith("feature-prefetch") for t in threads), \
+            threads
+        assert "feature.prefetch" in names
+
+        # stage intervals partition end-to-end latency
+        assert rec["e2e_seconds"] > 0.5
+        assert partition_check(rec), (rec["stages"], rec["e2e_seconds"])
+        assert rec["stages"]["sample"] >= 0.5  # the injected stall
+    finally:
+        rb.stop()
+        hs.stop()
+        server.stop()
+
+
+def test_errored_request_retained_with_error_event(small_graph, rng):
+    calls = {"n": 0}
+    model = GraphSAGE(hidden=8, out_dim=2, num_layers=2, dropout=0.0)
+    sampler0 = GraphSageSampler(small_graph, [4, 3])
+    n = small_graph.node_count
+    feat0 = rng.normal(size=(n, 8)).astype(np.float32)
+    feature0 = Feature(device_cache_size="1G").from_cpu_tensor(feat0)
+    b0 = sampler0.sample(np.arange(4, dtype=np.int64))
+    params = model.init(jax.random.PRNGKey(0),
+                        feature0[np.asarray(b0.n_id)], b0.layers)
+
+    def apply_fn(p, x, blocks):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("boom")
+        return model.apply(p if p is not None else params, x, blocks)
+
+    stream, rb, hs, server, _ = _cpu_stack(small_graph, rng,
+                                           apply_fn=apply_fn)
+    try:
+        req = ServingRequest(ids=np.array([1, 2], dtype=np.int64),
+                             client=0, seq=0)
+        stream.put(req)
+        got_req, out = server.result_queue.get(timeout=60)
+        assert isinstance(out, Exception)
+        # the lane survives: a second request still serves
+        _serve_one(stream, server, [3, 4], seq=1)
+
+        rec = flightrec.get_recorder().get(req.trace.trace_id)
+        assert rec is not None
+        assert rec["status"] == "error" and rec["reason"] == "error"
+        errs = [e for e in rec["events"] if e["name"] == "error"]
+        assert errs and errs[0]["attrs"]["type"] == "RuntimeError"
+        assert "boom" in errs[0]["attrs"]["message"]
+    finally:
+        rb.stop()
+        hs.stop()
+        server.stop()
+
+
+def test_flagged_request_retained_even_when_fast():
+    rec = flightrec.get_recorder()
+    tr = flightrec.new_trace()
+    tr.add("enqueue")
+    with flightrec.activate(tr):
+        flightrec.flag()
+    assert rec.finish(tr, 0.001, lane="cpu") == "flagged"
+    assert rec.get(tr.trace_id)["reason"] == "flagged"
+
+
+# ===================================================== loader propagation
+def test_loader_prefetch_worker_attributes_to_active_trace(small_graph,
+                                                           rng):
+    n = small_graph.node_count
+    feat = rng.normal(size=(n, 8)).astype(np.float32)
+    feature = Feature(device_cache_size="2K").from_cpu_tensor(feat)
+    sampler = GraphSageSampler(small_graph, [4, 3])
+    loader = SeedLoader(np.arange(n, dtype=np.int64), sampler, feature,
+                        batch_size=64, shuffle=False, prefetch=2)
+    tr = flightrec.new_trace()
+    with flightrec.activate(tr):
+        for _ in loader:
+            pass
+    names = [nm for _, nm, _, _ in tr.events]
+    assert "loader.batch" in names
+    assert "feature.prefetch" in names
+    main = threading.current_thread().name
+    batch_threads = {th for _, nm, th, _ in tr.events
+                     if nm == "loader.batch"}
+    # prefetch=2 runs _make on the Prefetcher worker, which carries the
+    # consumer's contextvars snapshot across the thread boundary
+    assert batch_threads and all(th != main for th in batch_threads)
+    pf_threads = {th for _, nm, th, _ in tr.events
+                  if nm == "feature.prefetch"}
+    assert all(th.startswith("feature-prefetch") for th in pf_threads)
+
+
+# ===================================================== SLO watchdog
+def _mk_watchdog(**kw):
+    kw.setdefault("interval_s", 60.0)
+    return SLOWatchdog(**kw)
+
+
+def test_slo_p99_breach_ticks_counter():
+    wd = _mk_watchdog(p99_ms=10.0, error_ratio=0.5)
+    h = telemetry.histogram("serving_request_seconds", lane="cpu")
+    for _ in range(5):
+        h.observe(0.5)
+    results = {r["objective"]: r for r in wd.evaluate_once()}
+    p99 = results["p99_latency"]
+    assert p99["breaching"] and p99["samples"] == 5
+    assert p99["value"] > 10.0 and p99["burn"] > 1.0
+    snap = telemetry.get_registry().snapshot()
+    assert snap["counters"][
+        "slo_breaches_total{objective=p99_latency}"] == 1
+    # empty next window: no samples, no breach, no double-count
+    results2 = {r["objective"]: r for r in wd.evaluate_once()}
+    assert results2["p99_latency"]["samples"] == 0
+    assert not results2["p99_latency"]["breaching"]
+    snap2 = telemetry.get_registry().snapshot()
+    assert snap2["counters"][
+        "slo_breaches_total{objective=p99_latency}"] == 1
+
+
+def test_slo_error_ratio_and_coldcache_floor():
+    wd = _mk_watchdog(p99_ms=1e9, error_ratio=0.1,
+                      coldcache_hit_floor=0.9)
+    for _ in range(8):
+        telemetry.counter("serving_requests_total", status="ok").inc()
+    for _ in range(2):
+        telemetry.counter("serving_requests_total", status="error").inc()
+    telemetry.counter("feature_coldcache_rows_total",
+                      result="hit").inc(5)
+    telemetry.counter("feature_coldcache_rows_total",
+                      result="miss").inc(5)
+    results = {r["objective"]: r for r in wd.evaluate_once()}
+    err = results["error_ratio"]
+    assert err["breaching"] and err["value"] == pytest.approx(0.2)
+    cc = results["coldcache_hit_rate"]
+    assert cc["breaching"] and cc["value"] == pytest.approx(0.5)
+    assert cc["burn"] > 1.0
+    assert not results["p99_latency"]["breaching"]
+
+
+def test_slo_status_json_and_thread_lifecycle():
+    wd = _mk_watchdog(interval_s=0.05, p99_ms=100.0)
+    st = wd.status()  # thread not running: evaluates on demand
+    assert st["running"] is False
+    assert {o["objective"] for o in st["objectives"]} >= {
+        "p99_latency", "error_ratio"}
+    json.dumps(st)  # must be plain JSON
+    wd.start()
+    assert wd.start() is wd  # idempotent
+    deadline = time.time() + 5
+    while wd.status()["ticks"] < 2 and time.time() < deadline:
+        time.sleep(0.02)
+    assert wd.status()["running"] is True
+    assert wd.status()["ticks"] >= 2
+    wd.stop()
+    assert wd.status()["running"] is False
+
+
+def test_watchdog_singleton_reset():
+    from quiver_tpu.telemetry import slo as slo_mod
+
+    wd = get_watchdog()
+    assert get_watchdog() is wd
+    slo_mod.reset()
+    assert get_watchdog() is not wd
+
+
+# ===================================================== /debug endpoints
+def test_debug_http_endpoints_round_trip():
+    from urllib.error import HTTPError
+    from urllib.request import Request, urlopen
+
+    from quiver_tpu.telemetry.export import start_http_server
+
+    rec = flightrec.get_recorder()
+    tr = flightrec.new_trace()
+    tr.add("enqueue", {"n_ids": 2})
+    tr.add("finish")
+    rec.finish(tr, 1.0, lane="cpu", stages={"sample": 1.0})
+
+    srv = start_http_server(port=0)
+    try:
+        idx = json.loads(urlopen(srv.url + "/debug/requests",
+                                 timeout=5).read().decode())
+        assert idx["count"] == 1
+        assert idx["capacity"] == rec.capacity
+        assert idx["records"][0]["trace_id"] == tr.trace_id
+        assert "events" not in idx["records"][0]  # index omits the log
+
+        full = json.loads(urlopen(
+            srv.url + f"/debug/requests/{tr.trace_id}",
+            timeout=5).read().decode())
+        assert [e["name"] for e in full["events"]] == ["enqueue", "finish"]
+        assert full["stages"] == {"sample": 1.0}
+
+        with pytest.raises(HTTPError) as ei:
+            urlopen(srv.url + "/debug/requests/nonesuch", timeout=5)
+        assert ei.value.code == 404
+
+        slo = json.loads(urlopen(srv.url + "/debug/slo",
+                                 timeout=5).read().decode())
+        assert slo["running"] is False
+        assert any(o["objective"] == "p99_latency"
+                   for o in slo["objectives"])
+
+        head = urlopen(Request(srv.url + "/debug/requests",
+                               method="HEAD"), timeout=5)
+        assert head.headers["Content-Type"].startswith("application/json")
+        assert head.read() == b""
+    finally:
+        srv.close()
+
+
+# ===================================================== retrace budget
+def test_steady_state_replay_builds_nothing_with_tracing_on(small_graph,
+                                                            rng):
+    """Tracing must not perturb jit caching: after warmup, a traced
+    replay over the same buckets compiles zero new executables."""
+    config_mod.update(flightrec_slow_ms=1e9)  # retain nothing
+    telemetry.reset()
+    n = small_graph.node_count
+    feat = rng.normal(size=(n, 8)).astype(np.float32)
+    feature = Feature(device_cache_size="1G").from_cpu_tensor(feat)
+    sampler = GraphSageSampler(small_graph, [4, 3])
+    model = GraphSAGE(hidden=16, out_dim=3, num_layers=2, dropout=0.0)
+    b0 = sampler.sample(np.arange(4, dtype=np.int64))
+    params = model.init(jax.random.PRNGKey(0),
+                        feature[np.asarray(b0.n_id)], b0.layers)
+    traces = []
+
+    @jax.jit
+    def apply_fn(p, x, blocks):
+        traces.append(1)  # body runs only on (re)trace
+        return model.apply(p, x, blocks)
+
+    stream = queue.Queue()
+    rb = RequestBatcher([stream], mode="Device").start()
+    server = InferenceServer(
+        sampler, feature, apply_fn, params, rb.device_batched_queue,
+        max_coalesce=1, fused=False)
+    server.BUCKETS = (4, 8, 16)
+    server.start()
+    try:
+        sizes = [3, 7, 12]  # one per bucket
+        for seq, sz in enumerate(sizes):  # warmup: compiles each bucket
+            _serve_one(stream, server, np.arange(sz), seq)
+        n_traces = len(traces)
+        with count_jit_builds() as c:
+            for seq, sz in enumerate(sizes * 3):  # steady-state replay
+                req, _ = _serve_one(stream, server, np.arange(sz),
+                                    100 + seq)
+                assert req.trace is not None  # tracing really was on
+        assert c.builds == 0
+        assert len(traces) == n_traces
+    finally:
+        rb.stop()
+        server.stop()

@@ -60,6 +60,9 @@ def _repo_copy_with(tmp_path, relpath, appended):
      "    for x in xs:\n"
      "        x = jax.jit(f)(x)\n"
      "    return x\n"),
+    ("quiver_tpu/serving.py", "QT006",
+     "\n\ndef _bad_metric(bucket):\n"
+     "    telemetry.counter(f\"serving_bucket_{bucket}_total\").inc()\n"),
 ])
 def test_injected_violation_fails_cli(tmp_path, relpath, code, appended):
     root = _repo_copy_with(tmp_path, relpath, appended)

@@ -298,6 +298,88 @@ class TestHygiene:
         assert r.findings == []
 
 
+# ------------------------------------------------------------ QT006
+class TestMetricNames:
+    def test_flags_fstring_name(self, tmp_path):
+        r = run_lint(tmp_path, """
+            from quiver_tpu import telemetry
+
+            def f(bucket):
+                telemetry.counter(f"requests_{bucket}_total").inc()
+        """)
+        assert codes(r) == ["QT006"]
+        assert "f-string" in r.findings[0].message
+
+    def test_flags_variable_name(self, tmp_path):
+        r = run_lint(tmp_path, """
+            from quiver_tpu import telemetry
+
+            def f(name):
+                telemetry.gauge(name).set(1)
+        """)
+        assert codes(r) == ["QT006"]
+        assert "literal" in r.findings[0].message
+
+    def test_flags_missing_unit_suffix_and_bad_case(self, tmp_path):
+        r = run_lint(tmp_path, """
+            from quiver_tpu import telemetry
+
+            def f():
+                telemetry.counter("requestsServed").inc()
+                telemetry.histogram("gather_latency").observe(0.1)
+        """)
+        assert codes(r) == ["QT006", "QT006"]
+        msgs = " ".join(f.message for f in r.findings)
+        assert "snake_case" in msgs and "unit suffix" in msgs
+
+    def test_flags_star_label_expansion(self, tmp_path):
+        r = run_lint(tmp_path, """
+            from quiver_tpu import telemetry
+
+            def f(labels):
+                telemetry.counter("requests_total", **labels).inc()
+        """)
+        assert codes(r) == ["QT006"]
+        assert "label keys" in r.findings[0].message
+
+    def test_bare_factory_import_is_matched(self, tmp_path):
+        r = run_lint(tmp_path, """
+            from quiver_tpu.telemetry import counter
+
+            def f():
+                counter("badName").inc()
+        """)
+        assert codes(r) == ["QT006"]
+
+    def test_clean_calls_pass(self, tmp_path):
+        r = run_lint(tmp_path, """
+            from quiver_tpu import telemetry
+
+            def f():
+                telemetry.counter("requests_total", lane="cpu",
+                                  help="Requests served").inc()
+                telemetry.gauge("queue_depth_total").set(3)
+                telemetry.histogram("gather_seconds", bounds=[0.1, 1.0],
+                                    tier="hot").observe(0.2)
+        """)
+        assert r.findings == []
+
+    def test_registry_internals_not_matched(self, tmp_path):
+        # forwarding paths (merge) re-create metrics from parsed keys;
+        # names there were validated at their facade call site
+        r = run_lint(tmp_path, """
+            class R:
+                def counter(self, name, **labels):
+                    return name
+
+                def merge(self, snap):
+                    for key, v in snap.items():
+                        name, labels = key, {}
+                        self.counter(name, **labels)
+        """)
+        assert r.findings == []
+
+
 # ------------------------------------------------ suppression plumbing
 class TestSuppression:
     def test_same_line_suppression(self, tmp_path):

@@ -420,3 +420,146 @@ class TestOverhead:
             telemetry.counter("x").inc()
         per_op = (time.perf_counter() - t0) / n
         assert per_op < 20e-6, f"noop counter {per_op * 1e9:.0f}ns/op"
+
+
+# ------------------------------------------ quantile boundary regressions
+class TestQuantileBoundaries:
+    def test_merged_histogram_without_minmax_never_reports_inf(self):
+        """A histogram populated purely via merge_dict (older snapshots /
+        deltas without min/max) used to leak the +/-inf sentinels through
+        percentile's observed-range clamp."""
+        h = Histogram(bounds=[1.0, 2.0, 4.0])
+        h.merge_dict({"bounds": [1.0, 2.0, 4.0],
+                      "counts": [0, 0, 0, 7], "sum": 70.0})
+        for q in (0, 50, 99, 100):
+            v = h.percentile(q)
+            assert np.isfinite(v)
+            # at/beyond the last bound clamps to the last finite bound
+            assert v <= 4.0
+
+    def test_overflow_observation_clamps_to_observed_max(self):
+        h = Histogram(bounds=[1.0, 2.0])
+        h.observe(10.0)  # overflow bucket, but max IS known
+        assert h.percentile(99) == 10.0
+
+    def test_inf_observation_clamps_to_last_finite_bound(self):
+        h = Histogram(bounds=[1.0, 2.0])
+        h.observe(float("inf"))
+        assert h.percentile(99) == 2.0
+
+    def test_single_observation_reports_its_value_at_p50_and_p99(self):
+        h = Histogram(bounds=[1.0, 2.0, 4.0, 8.0])
+        h.observe(3.0)
+        assert h.percentile(50) == 3.0
+        assert h.percentile(99) == 3.0
+
+
+# ------------------------------------------------------- HELP and HEAD
+class TestHelpExposition:
+    def test_help_flows_to_prometheus_text(self):
+        telemetry.counter("requests_total", help="Requests served",
+                          lane="cpu").inc(2)
+        telemetry.histogram("gather_seconds",
+                            help="Gather latency").observe(0.1)
+        text = to_prometheus_text(telemetry.snapshot())
+        lines = text.splitlines()
+        assert "# HELP requests_total Requests served" in lines
+        assert "# HELP gather_seconds Gather latency" in lines
+        # HELP precedes TYPE for the same family
+        assert lines.index("# HELP requests_total Requests served") < \
+            lines.index("# TYPE requests_total counter")
+
+    def test_help_escaping(self):
+        telemetry.counter("odd_total", help="line1\nback\\slash").inc()
+        text = to_prometheus_text(telemetry.snapshot())
+        assert "# HELP odd_total line1\\nback\\\\slash" in text
+
+    def test_snapshot_without_help_keeps_exact_shape(self):
+        telemetry.counter("plain_total").inc()
+        snap = telemetry.snapshot()
+        assert set(snap) == {"counters", "gauges", "histograms"}
+
+    def test_first_help_wins_and_merge_folds_help(self):
+        reg = MetricsRegistry()
+        reg.counter("x_total", help="first")
+        reg.counter("x_total", help="second")
+        assert reg.snapshot()["help"] == {"x_total": "first"}
+        reg2 = MetricsRegistry()
+        reg2.merge(reg.snapshot())
+        assert reg2.snapshot()["help"] == {"x_total": "first"}
+
+    def test_head_request_matches_get_headers(self):
+        from urllib.request import Request, urlopen
+
+        from quiver_tpu.telemetry.export import start_http_server
+
+        telemetry.counter("probe_total").inc()
+        srv = start_http_server()
+        try:
+            for path in ("/metrics", "/metrics.json"):
+                got = urlopen(srv.url + path)
+                head = urlopen(Request(srv.url + path, method="HEAD"))
+                assert head.status == 200
+                assert head.headers["Content-Type"] == \
+                    got.headers["Content-Type"]
+                assert int(head.headers["Content-Length"]) == \
+                    len(got.read())
+                assert head.read() == b""
+            # unknown path still 404s for HEAD
+            try:
+                urlopen(Request(srv.url + "/nope", method="HEAD"))
+                assert False, "expected 404"
+            except Exception as e:
+                assert getattr(e, "code", None) == 404
+        finally:
+            srv.close()
+
+
+# --------------------------------------------- concurrent merge+snapshot
+class TestConcurrentMergeSnapshot:
+    def test_merge_and_snapshot_thread_hammer(self):
+        """The dist path ships flight-record summaries by merging worker
+        snapshots while exporters snapshot concurrently: no lost
+        increments, no dict-mutation crashes."""
+        reg = MetricsRegistry()
+        n_workers, n_rounds = 6, 200
+        errors = []
+        done = threading.Event()
+
+        def producer(w):
+            try:
+                src = MetricsRegistry()
+                for i in range(n_rounds):
+                    src.reset()
+                    src.counter("hammer_total", worker=str(w)).inc()
+                    src.histogram("hammer_seconds",
+                                  bounds=[0.1, 1.0]).observe(0.5)
+                    reg.merge(src.snapshot())
+            except Exception as e:  # surface on the main thread
+                errors.append(e)
+
+        def reader():
+            try:
+                while not done.is_set():
+                    snap = reg.snapshot()
+                    to_prometheus_text(snap)  # exercises iteration too
+            except Exception as e:
+                errors.append(e)
+
+        readers = [threading.Thread(target=reader) for _ in range(2)]
+        producers = [threading.Thread(target=producer, args=(w,))
+                     for w in range(n_workers)]
+        for t in readers + producers:
+            t.start()
+        for t in producers:
+            t.join()
+        done.set()
+        for t in readers:
+            t.join()
+        assert errors == []
+        snap = reg.snapshot()
+        for w in range(n_workers):
+            key = "hammer_total{worker=%d}" % w
+            assert snap["counters"][key] == n_rounds
+        h = snap["histograms"]["hammer_seconds"]
+        assert sum(h["counts"]) == n_workers * n_rounds
