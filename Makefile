@@ -1,6 +1,6 @@
 # Top-level convenience targets (parity: reference ./configure && make).
 .PHONY: all native test test-quick test-native asan bench smoke \
-	telemetry-check lint help
+	telemetry-check chaos lint help
 
 all: native
 
@@ -29,9 +29,13 @@ test-quick:
 telemetry-check:
 	python -m pytest tests/ -m telemetry -q
 
+# deterministic fault-injection suite (docs/RESILIENCE.md)
+chaos:
+	python -m pytest tests/ -m chaos -q
+
 # quiverlint: hot-path static analysis (docs/STATIC_ANALYSIS.md)
 lint:
 	python -m quiver_tpu.analysis quiver_tpu bench.py
 
 help:
-	@echo "targets: native | test | test-quick | test-native | asan | bench | smoke | telemetry-check | lint"
+	@echo "targets: native | test | test-quick | test-native | asan | bench | smoke | telemetry-check | chaos | lint"

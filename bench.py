@@ -1070,6 +1070,98 @@ def bench_serving_flightrec(topo, dim, classes, n_requests=300,
     return st
 
 
+def bench_serving_resilience(topo, dim, classes, n_requests=300,
+                             gather_mode="auto", deadline_ms=250.0,
+                             queue_depth=32):
+    """Resilience A/B under synthetic overload: the whole replayed
+    workload is offered as one burst (no pacing), far faster than the
+    device lane drains.
+
+      * shedding ON  — bounded lanes (``queue_depth``, watermark
+        admission control) + a ``deadline_ms`` budget per request: the
+        lane sheds early so every request it *does* admit finishes
+        inside its budget.
+      * shedding OFF — ``serving_deadline_ms=0`` and unbounded plain
+        queues (the pre-resilience path, which is also the production
+        steady state when the knobs are off): every request queues and
+        the tail inherits the full backlog.
+
+    The headline is the served-p99 ratio (bounded vs backlog-shaped)
+    plus the OFF arm's p50 — the disabled-checks cost, which must stay
+    at the plain-path level (the deadline check is one ``is None``, a
+    chaos point is one module-global read)."""
+    import queue as _queue
+
+    import quiver_tpu.config as config_mod
+    from quiver_tpu.resilience.errors import ResilienceError
+    from quiver_tpu.serving import (InferenceServer_Debug, RequestBatcher,
+                                    ServingRequest)
+
+    setup = _serving_setup(topo, dim, classes, 128, gather_mode)
+    sampler, feature = setup["sampler"], setup["feature"]
+    params, apply_fn = setup["params"], setup["apply_fn"]
+    workload = _serving_workload(setup["n"], n_requests)
+
+    cfg = config_mod.get_config()
+    saved = {k: getattr(cfg, k) for k in
+             ("serving_deadline_ms", "serving_queue_depth")}
+
+    def run(shedding):
+        config_mod.update(
+            serving_deadline_ms=deadline_ms if shedding else 0.0,
+            serving_queue_depth=queue_depth if shedding else 0)
+        rq = _queue.Queue()
+        stream = _queue.Queue()
+        batcher = RequestBatcher(
+            [stream], mode="Device",
+            result_queue=rq if shedding else None).start()
+        server = InferenceServer_Debug(
+            sampler, feature, apply_fn, params,
+            batcher.device_batched_queue, result_queue=rq)
+        served = shed = errors = 0
+        try:
+            server.warmup()
+            server.start()
+            t0 = time.perf_counter()
+            for i, ids in enumerate(workload):  # burst: no pacing
+                stream.put(ServingRequest(ids=ids, client=0, seq=i))
+            for _ in range(n_requests):
+                _, out = server.result_queue.get(timeout=300)
+                if isinstance(out, ResilienceError):
+                    shed += 1
+                elif isinstance(out, Exception):
+                    errors += 1
+                else:
+                    served += 1
+            wall = time.perf_counter() - t0
+        finally:
+            server.stop()
+            batcher.stop()
+        st = server.stats()
+        return dict(p50_ms=round(st["p50_latency_ms"], 2),
+                    p99_ms=round(st["p99_latency_ms"], 2),
+                    served=served, shed=shed, errors=errors,
+                    wall_s=round(wall, 2))
+
+    try:
+        on = run(shedding=True)
+        off = run(shedding=False)
+    finally:
+        config_mod.update(**saved)
+    st = dict(
+        shedding_on=on, shedding_off=off,
+        deadline_ms=deadline_ms, queue_depth=queue_depth,
+        count=n_requests,
+        served_p99_ratio=round(on["p99_ms"] / max(off["p99_ms"], 1e-9), 3),
+        gather_mode=sampler.gather_mode,
+    )
+    log(f"serving_resilience: ON p99 {on['p99_ms']} ms "
+        f"({on['served']} served, {on['shed']} shed) vs OFF p99 "
+        f"{off['p99_ms']} ms ({off['served']} served) — "
+        f"p99 ratio {st['served_p99_ratio']}")
+    return st
+
+
 # ---------------------------------------------------------------- main
 def main():
     ap = argparse.ArgumentParser()
@@ -1078,7 +1170,8 @@ def main():
     ap.add_argument("--iters", type=int, default=20)
     ap.add_argument("--sections",
                     default="sampling,feature,feature_coldcache,e2e,"
-                            "serving,serving_flightrec,quality",
+                            "serving,serving_flightrec,"
+                            "serving_resilience,quality",
                     help="comma-separated subset to run")
     ap.add_argument("--ab-dedup", action="store_true",
                     help="also measure dedup='hop' for sampling + e2e")
@@ -1241,6 +1334,12 @@ def main():
                                                    classes, n_requests,
                                                    gather_mode=gm))
 
+    def run_resilience_section(gm):
+        runner.run("serving_resilience", 900,
+                   lambda: bench_serving_resilience(topo, feat_dim,
+                                                    classes, n_requests,
+                                                    gather_mode=gm))
+
     # pre-probe pass under the resolved library default: the sections the
     # judge has zero on-chip numbers for land before the probe can eat
     # the window.  If the probe later picks a different winner, the
@@ -1254,6 +1353,8 @@ def main():
         run_serving_sections(gm_default)
     if "serving_flightrec" in want:
         run_flightrec_section(gm_default)
+    if "serving_resilience" in want:
+        run_resilience_section(gm_default)
 
     if "sampling" in want:
         if args.gather_mode or args.small:
@@ -1275,6 +1376,8 @@ def main():
             run_serving_sections(gm)
         if "serving_flightrec" in want:
             run_flightrec_section(gm)
+        if "serving_resilience" in want:
+            run_resilience_section(gm)
         results = []
         for b in batches:
             r = runner.run(

@@ -92,6 +92,7 @@ _DEFAULT_HOT = (
     "quiver_tpu/ops/*.py",
     "quiver_tpu/ops/pallas/*.py",
     "quiver_tpu/parallel/*.py",
+    "quiver_tpu/resilience/*.py",
 )
 
 

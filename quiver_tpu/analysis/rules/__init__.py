@@ -11,11 +11,13 @@ from .qt003_locks import LockDisciplineRule
 from .qt004_layering import ImportLayeringRule
 from .qt005_hygiene import HygieneRule
 from .qt006_metric_names import MetricNameRule
+from .qt007_silent_except import SilentExceptRule
 
 __all__ = ["all_rules", "RULE_CLASSES"]
 
 RULE_CLASSES = (HostSyncRule, RetraceRule, LockDisciplineRule,
-                ImportLayeringRule, HygieneRule, MetricNameRule)
+                ImportLayeringRule, HygieneRule, MetricNameRule,
+                SilentExceptRule)
 
 
 def all_rules() -> List[Rule]:

@@ -59,6 +59,35 @@ class Config:
     max_coalesce: int = field(
         default_factory=lambda: _env("MAX_COALESCE", 8, int)
     )
+    # resilience (docs/RESILIENCE.md): per-request deadline budget in ms
+    # (0 disables deadlines entirely — checks reduce to one `is None`),
+    # bounded-lane capacity + shed watermarks (fractions of capacity,
+    # hysteresis: shed above high until drained below low), and the
+    # per-lane circuit breaker (consecutive failures to open, seconds
+    # until a half-open probe, concurrent probes admitted)
+    serving_deadline_ms: float = field(
+        default_factory=lambda: _env("SERVING_DEADLINE_MS", 0.0, float)
+    )
+    serving_queue_depth: int = field(
+        default_factory=lambda: _env("SERVING_QUEUE_DEPTH", 1024, int)
+    )
+    serving_queue_high_watermark: float = field(
+        default_factory=lambda: _env(
+            "SERVING_QUEUE_HIGH_WATERMARK", 0.9, float)
+    )
+    serving_queue_low_watermark: float = field(
+        default_factory=lambda: _env(
+            "SERVING_QUEUE_LOW_WATERMARK", 0.5, float)
+    )
+    serving_breaker_failures: int = field(
+        default_factory=lambda: _env("SERVING_BREAKER_FAILURES", 5, int)
+    )
+    serving_breaker_reset_s: float = field(
+        default_factory=lambda: _env("SERVING_BREAKER_RESET_S", 30.0, float)
+    )
+    serving_breaker_probes: int = field(
+        default_factory=lambda: _env("SERVING_BREAKER_PROBES", 1, int)
+    )
     # flight recorder (docs/OBSERVABILITY.md): ring-buffer capacity of
     # retained request records, and the e2e latency above which an
     # otherwise-healthy request counts as "slow" and is retained

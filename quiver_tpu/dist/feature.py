@@ -30,7 +30,14 @@ try:
 except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map  # type: ignore
 
+from ..resilience import chaos
+from ..resilience.errors import PeerTimeout
+
 __all__ = ["PartitionInfo", "DistFeature"]
+
+# fault-injection site for the cross-host exchange (no-op unless a
+# chaos plan is installed)
+_CHAOS_EXCHANGE = chaos.point("dist.feature.exchange")
 
 
 class PartitionInfo:
@@ -134,6 +141,10 @@ class DistFeature:
         self.cold_cache = None    # ColdRowCache over global-id space
         self._overlay = None      # jax.Array [C, D] per-host overlay table
         self._ov_lock = threading.Lock()
+        # degrade telemetry: True when the most recent lookup fell back
+        # to locally resolvable rows on a peer-shard timeout
+        self.last_degraded = False
+        self.last_degraded_mask = None
 
     @classmethod
     def from_global_feature(cls, feature: np.ndarray, mesh: Mesh,
@@ -394,7 +405,17 @@ class DistFeature:
         sharding = NamedSharding(self.mesh, P(self.axis, None))
         ids = jax.device_put(ids, sharding)
         valid = jax.device_put(valid, sharding)
-        out, overflow = self._fn[key](self.shards, ids, valid)
+        try:
+            _CHAOS_EXCHANGE()
+            out, overflow = self._fn[key](self.shards, ids, valid)
+        except (PeerTimeout, TimeoutError):
+            # peer shard timed out: degrade to the rows resolvable
+            # WITHOUT the collective (owned / replicated / overlay-hit),
+            # zeros elsewhere, flagged via last_degraded — stale-local
+            # beats stalling the whole serving pipeline on one peer
+            return self._degraded_lookup(np.asarray(ids),
+                                         np.asarray(valid))
+        self.last_degraded = False
         self.last_overflow = overflow
         self._overflow_recorded = False
         if ov_patch is not None:
@@ -405,6 +426,46 @@ class DistFeature:
             flightrec.event("dist.lookup", {
                 "hosts": int(nh), "batch": int(B),
                 "overlay_patched": ov_patch is not None})
+        return out
+
+    def _degraded_lookup(self, ids: np.ndarray, valid: np.ndarray):
+        """Peer-timeout fallback: each host row keeps the rows its own
+        shard can answer (owned by it, replicated everywhere, or — for
+        this host — sitting in the cold-row overlay); everything else
+        comes back zero.  ``last_degraded`` flags the result and
+        ``last_degraded_mask`` says which rows are real."""
+        from .. import telemetry
+        from ..telemetry import flightrec
+
+        info = self.info
+        src = self._host_source
+        assert src is not None, (
+            "degraded lookup needs from_global_feature (the host-side "
+            "source copy is the hot tier it serves from)")
+        nh, B = ids.shape
+        owner = info.global2host[ids]
+        local = valid & (info.replicate_mask[ids]
+                         | (owner == np.arange(nh)[:, None]))
+        if self.cold_cache is not None:
+            me = info.host
+            pos = np.nonzero(valid[me] & ~local[me])[0]
+            if len(pos):
+                with self._ov_lock:
+                    hit, _ = self.cold_cache.probe(
+                        ids[me, pos].astype(np.int64))
+                local[me, pos[hit]] = True
+        out = np.zeros((nh, B, src.shape[1]), dtype=src.dtype)
+        out[local] = src[ids[local]]
+        self.last_degraded = True
+        self.last_degraded_mask = local
+        self.last_overflow = np.zeros((nh,), np.int32)
+        self._overflow_recorded = True
+        telemetry.counter("dist_feature_degraded_total").inc()
+        if flightrec.tracing():
+            flightrec.event("dist.lookup", {
+                "degraded": True, "hosts": int(nh), "batch": int(B),
+                "served": int(local.sum()),
+                "dropped": int((valid & ~local).sum())})
         return out
 
     def overflow_stats(self):

@@ -26,11 +26,17 @@ try:
 except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map  # type: ignore
 
+from ..resilience import chaos
+from ..resilience.errors import PeerTimeout
 from ..utils.topology import CSRTopo
 from ..ops.sample import sample_neighbors
 from ..sampler import LayerBlock, SampledBatch
 
 __all__ = ["DistGraphSampler", "shard_csr_by_rows", "plan_row_shards"]
+
+# fault-injection site for the per-hop all-to-all exchange (no-op
+# unless a chaos plan is installed)
+_CHAOS_EXCHANGE = chaos.point("dist.sampler.exchange")
 
 
 def plan_row_shards(indptr, n_shards: int,
@@ -297,10 +303,25 @@ class DistGraphSampler:
         sh = NamedSharding(self.mesh, P(self.axis, None))
         seeds = jax.device_put(seeds, sh)
         valid = jax.device_put(valid, sh)
-        n_id, n_mask, num, blocks, overflow = self._fn[B](
-            self.indptr_sh, self.indices_sh, seeds, valid,
-            jnp.int32(key),
-        )
+        try:
+            _CHAOS_EXCHANGE()
+            n_id, n_mask, num, blocks, overflow = self._fn[B](
+                self.indptr_sh, self.indices_sh, seeds, valid,
+                jnp.int32(key),
+            )
+        except (PeerTimeout, TimeoutError):
+            # one immediate retry — a transient peer stall usually
+            # clears; a second timeout surfaces to the caller (sampling
+            # has no partial-answer degrade: a frontier with holes would
+            # silently bias the training batch)
+            from .. import telemetry
+
+            telemetry.counter("dist_sampler_retries_total").inc()
+            _CHAOS_EXCHANGE()
+            n_id, n_mask, num, blocks, overflow = self._fn[B](
+                self.indptr_sh, self.indices_sh, seeds, valid,
+                jnp.int32(key),
+            )
         self.last_overflow = overflow
         self._overflow_recorded = False
         return n_id, n_mask, num, blocks

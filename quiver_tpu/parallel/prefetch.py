@@ -16,6 +16,8 @@ import queue
 import threading
 from typing import Callable, Iterable, Iterator
 
+from ..resilience.shutdown import join_and_reap
+
 __all__ = ["Prefetcher", "AsyncNeighborSampler", "AsyncCudaNeighborSampler"]
 
 _END = object()
@@ -25,21 +27,33 @@ class Prefetcher:
     """Wrap a batch-producing callable over an index iterable.
 
     ``make_batch(item)`` runs on the worker thread (sample + gather +
-    device_put); consumers iterate finished batches.
+    device_put); consumers iterate finished batches.  :meth:`stop`
+    terminates an in-flight iteration from any thread — the worker's
+    bounded put and the consumer's get are both shutdown-aware, so a
+    wedged consumer (stopped draining, never closed the generator)
+    cannot deadlock the worker against the full queue.
     """
 
     def __init__(self, items: Iterable, make_batch: Callable, depth: int = 2):
         self.items = list(items)
         self.make_batch = make_batch
         self.depth = depth
+        self._stop: "threading.Event" = threading.Event()
+        self._thread = None
 
     def __len__(self):
         return len(self.items)
 
+    def stop(self) -> None:
+        """Request shutdown of the current iteration (idempotent, safe
+        from any thread).  The worker exits its put loop within one
+        timeout tick; a consumer blocked in get() exits on its next."""
+        self._stop.set()
+
     def __iter__(self) -> Iterator:
         q: "queue.Queue" = queue.Queue(maxsize=self.depth)
         exc = []
-        stop = threading.Event()
+        stop = self._stop = threading.Event()
         # snapshot the consumer's context (flight-recorder trace, etc.)
         # so worker-side batch building attributes to whoever started
         # the iteration — threads do not inherit contextvars.  Sequential
@@ -47,8 +61,9 @@ class Prefetcher:
         cvctx = contextvars.copy_context()
 
         def _put_interruptible(item) -> bool:
-            # a consumer that abandons iteration early (break / exception)
-            # stops draining; a plain q.put would then block this worker
+            # shutdown-aware bounded put: a consumer that abandons
+            # iteration early (break / exception) or an external stop()
+            # ends the wait; a plain q.put would block this worker
             # forever on the full bounded queue
             while not stop.is_set():
                 try:
@@ -65,22 +80,32 @@ class Prefetcher:
                         return
                     if not _put_interruptible(cvctx.run(self.make_batch, it)):
                         return
-            except BaseException as e:  # surface on the consumer side
+            except BaseException as e:
+                # surfaced on the consumer side: __iter__ re-raises
+                # exc[0] after the join below
                 exc.append(e)
             finally:
                 _put_interruptible(_END)
 
         t = threading.Thread(target=worker, daemon=True)
+        self._thread = t
         t.start()
         try:
             while True:
-                out = q.get()
+                try:
+                    out = q.get(timeout=0.2)
+                except queue.Empty:
+                    # stopped AND worker gone: no _END is coming (its
+                    # put was interrupted) — exit instead of waiting
+                    if stop.is_set() and not t.is_alive():
+                        break
+                    continue
                 if out is _END:
                     break
                 yield out
         finally:
             stop.set()
-            t.join(timeout=5)
+            join_and_reap([t], timeout=5.0, component="prefetcher")
         if exc:
             raise exc[0]
 

@@ -1,0 +1,46 @@
+"""quiver_tpu.resilience — fault tolerance for the serving pipeline.
+
+PR 4's SLO watchdog *detects* breaches; this package makes the system
+*react* to them.  Four mechanisms, threaded through serving, loader,
+prefetch, and dist (``docs/RESILIENCE.md``):
+
+  * **deadlines** (:mod:`.deadline`) — every :class:`ServingRequest`
+    carries an absolute deadline (``config.serving_deadline_ms``);
+    each stage boundary sheds expired requests with a typed
+    :class:`~quiver_tpu.resilience.errors.DeadlineExceeded` answer
+    instead of letting them age silently in a queue.
+  * **bounded queues + admission control** (:mod:`.lanes`) —
+    :class:`BoundedLane` wraps the stage queues with capacity and
+    high/low watermarks, shedding lowest-priority work first and
+    ticking ``serving_shed_total{reason}``.
+  * **circuit breaking + lane failover** (:mod:`.breaker`) — repeated
+    device-lane failures trip a per-lane closed→open→half-open
+    :class:`CircuitBreaker`; in-flight requests reroute to the CPU
+    sampler lane, and ``DistFeature.lookup`` degrades to locally
+    resolvable rows (``degraded=True``) on a peer-shard timeout.
+  * **deterministic fault injection** (:mod:`.chaos`) — named
+    injection points (``chaos.point("serving.device_lane")``) compile
+    to one attribute read + None-check when no plan is installed, and
+    replay byte-identically under a seeded :class:`ChaosPlan`.
+
+Everything emits flight-recorder events and registry metrics (breaker
+state gauge, shed / retry / degraded counters) so ``/debug/slo`` and
+``/debug/breakers`` show remediation, not just breach.
+"""
+
+from __future__ import annotations
+
+from .breaker import CircuitBreaker, breakers_status, get_breaker
+from .chaos import ChaosPlan, point
+from .deadline import deadline_for, shed, shed_if_expired
+from .errors import (ChaosFault, DeadlineExceeded, LaneUnavailable,
+                     LoadShed, PeerTimeout, ResilienceError)
+from .lanes import BoundedLane
+from .shutdown import join_and_reap
+
+__all__ = [
+    "BoundedLane", "ChaosFault", "ChaosPlan", "CircuitBreaker",
+    "DeadlineExceeded", "LaneUnavailable", "LoadShed", "PeerTimeout",
+    "ResilienceError", "breakers_status", "deadline_for", "get_breaker",
+    "join_and_reap", "point", "shed", "shed_if_expired",
+]

@@ -1,0 +1,178 @@
+"""BoundedLane — a shedding, priority-aware stage queue.
+
+Drop-in for the ``queue.Queue`` subset the serving pipeline uses
+(``put`` / ``get`` / ``get_nowait`` / ``qsize`` / ``empty``), plus
+admission control:
+
+  * **capacity** — the lane never holds more than ``maxsize`` requests;
+    at capacity the lowest-priority request loses (the arrival, unless
+    a strictly lower-priority request is queued to displace).
+  * **watermarks with hysteresis** — crossing ``high`` (a fraction of
+    capacity) engages shedding mode, which persists until depth drains
+    below ``low``; while engaged, arrivals are shed unless they can
+    displace lower-priority queued work.  Shedding *early* keeps the
+    queue-wait of admitted requests bounded instead of letting every
+    request age toward its deadline.
+  * **deadline laziness** — an expired request found at ``get`` time is
+    shed on the spot (reason ``deadline``) rather than handed to a lane
+    that would do dead work.
+
+Sheds go through :func:`quiver_tpu.resilience.deadline.shed`: metric,
+flight record, typed answer on ``result_queue``.  Without a result
+queue the lane admits-or-forwards but never silently drops — control
+items (the ``_STOP`` sentinel and anything that is not a request) are
+always admitted and never shed.
+"""
+
+from __future__ import annotations
+
+import queue as _queue
+import threading
+import time
+from typing import List, Optional
+
+from .deadline import shed
+
+__all__ = ["BoundedLane"]
+
+
+def _req_of(item):
+    """The ServingRequest inside ``item`` (requests travel bare on the
+    batcher lanes and as ``(req, batch, dt)`` on the sampled lane)."""
+    if isinstance(item, tuple) and item:
+        item = item[0]
+    return item if hasattr(item, "t_enqueue") else None
+
+
+class BoundedLane:
+    """Bounded, watermark-shedding queue for one pipeline lane."""
+
+    _guarded_by = {"_items": "_cv", "_shedding": "_cv"}
+
+    def __init__(self, name: str, maxsize: Optional[int] = None,
+                 high: Optional[float] = None, low: Optional[float] = None,
+                 result_queue=None):
+        from ..config import get_config
+
+        cfg = get_config()
+        self.name = name
+        self.maxsize = int(maxsize if maxsize is not None
+                           else cfg.serving_queue_depth)
+        if self.maxsize <= 0:
+            raise ValueError(f"BoundedLane needs maxsize >= 1, got "
+                             f"{self.maxsize}")
+        high = float(high if high is not None
+                     else cfg.serving_queue_high_watermark)
+        low = float(low if low is not None
+                    else cfg.serving_queue_low_watermark)
+        if not 0.0 < low <= high <= 1.0:
+            raise ValueError(f"watermarks need 0 < low <= high <= 1, got "
+                             f"low={low} high={high}")
+        self.high = max(int(self.maxsize * high), 1)
+        self.low = max(int(self.maxsize * low), 0)
+        self.result_queue = result_queue
+        self._cv = threading.Condition()
+        self._items: List[object] = []
+        self._shedding = False
+
+    # -- producer side --------------------------------------------------
+    def put(self, item, block: bool = True,
+            timeout: Optional[float] = None) -> None:
+        """Admit, displace, or shed.  Control items always enqueue.
+        ``block``/``timeout`` are accepted for queue.Queue compatibility
+        but never block: at capacity this lane sheds instead."""
+        req = _req_of(item)
+        with self._cv:
+            if req is None:  # control item (_STOP): always through
+                self._items.append(item)
+                self._cv.notify()
+                return
+            depth = len(self._items)
+            if self._shedding and depth < self.low:
+                self._shedding = False
+            if depth >= self.high:
+                self._shedding = True
+            if not self._shedding and depth < self.maxsize:
+                self._items.append(item)
+                self._cv.notify()
+                return
+            # shedding mode (or hard-full): lowest priority loses
+            reason = "overflow" if depth >= self.maxsize else "watermark"
+            vi = self._victim_index(req)
+            if vi is None:
+                victim_item = item  # arrival is the lowest priority
+            else:
+                victim_item = self._items.pop(vi)
+                self._items.append(item)
+                self._cv.notify()
+        victim = _req_of(victim_item)
+        if self.result_queue is None:
+            # nobody to answer: a shed here would be a silent drop, so
+            # admit past the watermark instead (degenerates to the old
+            # unbounded queue.Queue behaviour — wire a result_queue to
+            # get admission control)
+            with self._cv:
+                self._items.append(victim_item)
+                self._cv.notify()
+            return
+        shed(victim, self.result_queue, self.name, reason)
+
+    def _victim_index(self, incoming) -> Optional[int]:
+        """Index of the oldest queued request with priority strictly
+        below ``incoming``'s (None: the incoming request is the victim).
+        Caller holds ``_cv``."""
+        inc_pri = getattr(incoming, "priority", 0)
+        best_i, best_pri = None, inc_pri
+        for i, it in enumerate(self._items):
+            r = _req_of(it)
+            if r is None:
+                continue
+            pri = getattr(r, "priority", 0)
+            if pri < best_pri:
+                best_i, best_pri = i, pri
+        return best_i
+
+    # -- consumer side --------------------------------------------------
+    def get(self, block: bool = True, timeout: Optional[float] = None):
+        """Pop the oldest item; expired requests are shed here (when
+        answerable) instead of being handed to the lane."""
+        deadline = (time.monotonic() + timeout) if timeout is not None \
+            else None
+        with self._cv:
+            while True:
+                while not self._items:
+                    if not block:
+                        raise _queue.Empty
+                    if deadline is None:
+                        self._cv.wait()
+                    else:
+                        left = deadline - time.monotonic()
+                        if left <= 0 or not self._cv.wait(left):
+                            if not self._items:
+                                raise _queue.Empty
+                    continue
+                item = self._items.pop(0)
+                if len(self._items) < self.low:
+                    self._shedding = False
+                req = _req_of(item)
+                if (req is not None and self.result_queue is not None
+                        and req.deadline is not None
+                        and time.perf_counter() >= req.deadline):
+                    shed(req, self.result_queue, self.name, "deadline")
+                    continue
+                return item
+
+    def get_nowait(self):
+        return self.get(block=False)
+
+    def qsize(self) -> int:
+        with self._cv:
+            return len(self._items)
+
+    def empty(self) -> bool:
+        return self.qsize() == 0
+
+    @property
+    def shedding(self) -> bool:
+        with self._cv:
+            return self._shedding

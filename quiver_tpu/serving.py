@@ -15,6 +15,16 @@ the next power of two) so every request size hits a cached jit executable —
 the TPU answer to CUDA's any-shape kernel launches.  Routing keeps the same
 mechanism: requests whose expected expansion is small run on the CPU
 sampler (low latency, no device round-trip), big ones batch onto the TPU.
+
+Fault tolerance (docs/RESILIENCE.md): requests carry absolute deadlines
+checked at every stage boundary; the batcher lanes are
+:class:`~quiver_tpu.resilience.BoundedLane`s that shed under overload;
+each server lane sits behind a :class:`~quiver_tpu.resilience.
+CircuitBreaker` and fails over to the other lane (device→CPU via an
+inline ``cpu_sampler`` pass, CPU→device via the bucketed forward); and
+the named ``chaos.point(...)`` call sites let the chaos suite inject
+faults deterministically.  A request is always *answered* — with its
+result or a typed resilience error — never silently dropped.
 """
 
 from __future__ import annotations
@@ -28,6 +38,12 @@ from typing import Callable, List, Optional, Sequence
 import numpy as np
 
 from . import telemetry
+from .resilience import chaos
+from .resilience.breaker import CircuitBreaker
+from .resilience.deadline import deadline_for, shed_if_expired
+from .resilience.errors import LaneUnavailable
+from .resilience.lanes import BoundedLane
+from .resilience.shutdown import join_and_reap
 from .telemetry import flightrec
 
 __all__ = [
@@ -36,6 +52,12 @@ __all__ = [
 ]
 
 _STOP = object()
+
+# named fault-injection call sites (no-ops unless a chaos plan is
+# installed — one module-global read + None check per fire)
+_CHAOS_DEVICE = chaos.point("serving.device_lane")
+_CHAOS_CPU = chaos.point("serving.cpu_lane")
+_CHAOS_SAMPLER = chaos.point("serving.hybrid_sampler")
 
 
 @dataclass
@@ -47,14 +69,41 @@ class ServingRequest:
     # flight-recorder trace context; None when telemetry is off (every
     # consumer guards, so the None threads through the pipeline for free)
     trace: Optional[object] = None
+    # absolute perf_counter deadline; defaults from
+    # config.serving_deadline_ms (None = no deadline, checks are free)
+    deadline: Optional[float] = None
+    # admission-control ordering: under overload the BoundedLanes shed
+    # strictly-lower-priority requests first
+    priority: int = 0
 
     def __post_init__(self):
+        if self.deadline is None:
+            self.deadline = deadline_for(self.t_enqueue)
         if self.trace is None:
             self.trace = flightrec.new_trace()
             if self.trace is not None:
                 self.trace.add("enqueue", {"n_ids": int(len(self.ids)),
                                            "client": self.client,
                                            "seq": self.seq})
+
+    def expired(self, now: Optional[float] = None) -> bool:
+        if self.deadline is None:
+            return False
+        return (now if now is not None else time.perf_counter()) \
+            >= self.deadline
+
+
+def _fail_request(req, exc, lane: str, result_queue) -> None:
+    """Shared error answer: retained flight record (reason=error) plus
+    the typed ``(req, exc)`` tuple on the result queue when one is in
+    scope — a failed request is reported, never swallowed."""
+    tr = getattr(req, "trace", None)
+    if tr is not None:
+        tr.add("error", {"type": type(exc).__name__, "message": str(exc)})
+        e2e = max(time.perf_counter() - req.t_enqueue, 0.0)
+        flightrec.get_recorder().finish(tr, e2e, status="error", lane=lane)
+    if result_queue is not None:
+        result_queue.put((req, exc))
 
 
 def _next_bucket(n: int, buckets: Sequence[int]) -> int:
@@ -76,21 +125,44 @@ class RequestBatcher:
         (serving.py:72-95).
       mode: "Auto" | "CPU" | "Device" | "Preparation" (duplicate to both,
         parity serving.py:60-70).
+      result_queue: where shed/rejected requests are *answered*.  When
+        given, the two lane queues become
+        :class:`~quiver_tpu.resilience.BoundedLane`s
+        (``config.serving_queue_depth`` capacity, watermark shedding)
+        and expired requests are shed at routing; without it the lanes
+        stay unbounded and nothing is shed here (there would be no way
+        to answer).
     """
 
     def __init__(self, stream_queues: List["queue.Queue"],
                  neighbour_num: Optional[np.ndarray] = None,
-                 threshold: float = 0.0, mode: str = "Auto"):
+                 threshold: float = 0.0, mode: str = "Auto",
+                 result_queue: Optional["queue.Queue"] = None):
         assert mode in ("Auto", "CPU", "Device", "Preparation")
         self.stream_queues = stream_queues
         self.neighbour_num = neighbour_num
         self.threshold = threshold
         self.mode = mode
-        self.cpu_batched_queue: "queue.Queue" = queue.Queue()
-        self.device_batched_queue: "queue.Queue" = queue.Queue()
+        self.result_queue = result_queue
+        if result_queue is not None:
+            from .config import get_config
+
+            depth = get_config().serving_queue_depth
+        else:
+            depth = 0
+        if depth > 0:
+            self.cpu_batched_queue = BoundedLane(
+                "cpu", result_queue=result_queue)
+            self.device_batched_queue = BoundedLane(
+                "device", result_queue=result_queue)
+        else:
+            self.cpu_batched_queue = queue.Queue()
+            self.device_batched_queue = queue.Queue()
         self._threads: List[threading.Thread] = []
 
     def _route(self, req: ServingRequest):
+        if shed_if_expired(req, self.result_queue, "batcher"):
+            return
         if self.mode == "CPU":
             self._put(self.cpu_batched_queue, req, "cpu")
         elif self.mode == "Device":
@@ -123,9 +195,35 @@ class RequestBatcher:
             item = q.get()
             if item is _STOP:
                 break
-            if not isinstance(item, ServingRequest):
-                item = ServingRequest(ids=np.asarray(item), client=-1, seq=-1)
-            self._route(item)
+            try:
+                if not isinstance(item, ServingRequest):
+                    item = ServingRequest(ids=np.asarray(item),
+                                          client=-1, seq=-1)
+                self._route(item)
+            except Exception as e:  # noqa: BLE001 — stream must survive
+                # a malformed payload (np.asarray raising, a broken ids
+                # dtype) used to kill this stream thread silently; now
+                # it is rejected and the thread keeps draining
+                self._reject(item, e)
+
+    def _reject(self, item, exc) -> None:
+        """Answer + account one unroutable payload: tick
+        ``serving_rejected_total``, retain a ``rejected`` flight record,
+        and answer on the result queue when the payload got far enough
+        to be answerable."""
+        telemetry.counter("serving_rejected_total").inc()
+        req = item if isinstance(item, ServingRequest) else None
+        tr = req.trace if req is not None else flightrec.new_trace()
+        if tr is not None:
+            tr.add("reject", {"type": type(exc).__name__,
+                              "message": str(exc),
+                              "payload": type(item).__name__})
+            t0 = req.t_enqueue if req is not None else tr.t_start
+            flightrec.get_recorder().finish(
+                tr, max(time.perf_counter() - t0, 0.0),
+                status="rejected", lane="batcher")
+        if req is not None and self.result_queue is not None:
+            self.result_queue.put((req, exc))
 
     def start(self):
         for q in self.stream_queues:
@@ -135,12 +233,16 @@ class RequestBatcher:
         return self
 
     def stop(self):
+        """Drain the stream threads; leaked (wedged) threads are logged
+        and ticked on ``serving_thread_leak_total`` instead of being
+        silently abandoned."""
         for q in self.stream_queues:
             q.put(_STOP)
-        for t in self._threads:
-            t.join(timeout=5)
+        leaked = join_and_reap(self._threads, timeout=5.0,
+                               component="batcher")
         self.cpu_batched_queue.put(_STOP)
         self.device_batched_queue.put(_STOP)
+        return leaked
 
 
 class HybridSampler:
@@ -161,9 +263,13 @@ class HybridSampler:
 
     def __init__(self, cpu_sampler, cpu_batched_queue: "queue.Queue",
                  num_workers: int = 2, buckets: Optional[Sequence] = None,
-                 feature=None):
+                 feature=None,
+                 result_queue: Optional["queue.Queue"] = None):
         self.sampler = cpu_sampler
         self.inq = cpu_batched_queue
+        # deadline sheds and sampler failures are answered here (None:
+        # expired items flow through for the server to shed)
+        self.result_queue = result_queue
         self.sampled_queue: "queue.Queue" = queue.Queue()
         self.num_workers = num_workers
         # optional lookahead: stage the sampled batch's feature rows on
@@ -192,15 +298,26 @@ class HybridSampler:
             if item is _STOP:
                 self.inq.put(_STOP)  # let siblings see it too
                 break
+            if shed_if_expired(item, self.result_queue, "sampler"):
+                continue
             t0 = time.perf_counter()
-            with flightrec.activate(item.trace):
-                batch = self.sampler.sample(self._pad(np.asarray(item.ids)))
-                dt = time.perf_counter() - t0
-                if flightrec.tracing():
-                    flightrec.event("sample", {
-                        "seconds": dt, "n_id": int(batch.n_id.shape[0])})
-                if self.feature is not None:
-                    self.feature.prefetch(batch.n_id)
+            try:
+                with flightrec.activate(item.trace):
+                    _CHAOS_SAMPLER()
+                    batch = self.sampler.sample(
+                        self._pad(np.asarray(item.ids)))
+                    dt = time.perf_counter() - t0
+                    if flightrec.tracing():
+                        flightrec.event("sample", {
+                            "seconds": dt,
+                            "n_id": int(batch.n_id.shape[0])})
+                    if self.feature is not None:
+                        self.feature.prefetch(batch.n_id)
+            except Exception as e:  # noqa: BLE001 — worker must survive
+                telemetry.counter("serving_requests_total",
+                                  lane="cpu", status="error").inc()
+                _fail_request(item, e, "sampler", self.result_queue)
+                continue
             self.sampled_queue.put((item, batch, dt))
 
     def start(self):
@@ -212,9 +329,10 @@ class HybridSampler:
 
     def stop(self):
         self.inq.put(_STOP)
-        for t in self._threads:
-            t.join(timeout=5)
+        leaked = join_and_reap(self._threads, timeout=5.0,
+                               component="sampler")
         self.sampled_queue.put(_STOP)
+        return leaked
 
 
 class InferenceServer:
@@ -237,7 +355,8 @@ class InferenceServer:
                  cpu_sampled_queue: Optional["queue.Queue"] = None,
                  result_queue: Optional["queue.Queue"] = None,
                  max_coalesce: Optional[int] = None,
-                 fused: Optional[bool] = None):
+                 fused: Optional[bool] = None,
+                 cpu_sampler=None):
         self.sampler = tpu_sampler
         self.feature = feature
         self.apply_fn = apply_fn
@@ -245,6 +364,16 @@ class InferenceServer:
         self.device_q = device_batched_queue
         self.cpu_q = cpu_sampled_queue
         self.result_queue = result_queue or queue.Queue()
+        # failover route for device-lane requests when the device lane
+        # fails or its breaker opens: an inline sample on the CPU
+        # sampler + the shared presampled forward.  None = no route
+        # (failed device requests are answered with the error, the
+        # pre-resilience behaviour).
+        self.cpu_sampler = cpu_sampler
+        # per-lane circuit breakers (config-driven thresholds; tests
+        # swap in instances with injected clocks)
+        self._breakers = {"device": CircuitBreaker("serving.device"),
+                          "cpu": CircuitBreaker("serving.cpu")}
         if max_coalesce is None:
             from .config import get_config
 
@@ -463,6 +592,16 @@ class InferenceServer:
                 self._drain_coalesce(item) if self.max_coalesce > 1
                 else [item]
             )
+            # stage-boundary deadline check: requests that aged out on
+            # the queue are shed (answered) before burning device time
+            reqs = [r for r in reqs
+                    if not shed_if_expired(r, self.result_queue, "device")]
+            if not reqs:
+                continue
+            br = self._breakers["device"]
+            if not br.allow():
+                self._failover(reqs, "device", None)
+                continue
             # dequeue stamp AFTER coalescing: queue_wait covers time on
             # the queue plus the drain, so the per-request intervals
             # (queue_wait + stages) still partition end-to-end latency
@@ -479,17 +618,16 @@ class InferenceServer:
                     if flightrec.tracing():
                         flightrec.event("dequeue",
                                         {"coalesced": len(reqs)})
+                    _CHAOS_DEVICE()
                     outs = self._infer_coalesced(reqs, stages)
+                br.record_success()
                 t_done = time.perf_counter()
                 for r, o in zip(reqs, outs):
                     self._finish(r, o, lane="device", stages=stages,
                                  t_dequeue=t_deq, t_done=t_done)
             except Exception as e:  # noqa: BLE001 — lane must survive
-                for r in reqs:
-                    telemetry.counter("serving_requests_total",
-                                      lane="device", status="error").inc()
-                    self._finish_error(r, e, lane="device")
-                    self.result_queue.put((r, e))
+                br.record_failure()
+                self._failover(reqs, "device", e)
 
     def _cpu_loop(self):
         while not self._stopped.is_set():
@@ -497,18 +635,90 @@ class InferenceServer:
             if item is _STOP:
                 break
             req, batch, sample_dt = item
+            if shed_if_expired(req, self.result_queue, "cpu"):
+                continue
+            br = self._breakers["cpu"]
+            if not br.allow():
+                self._failover([req], "cpu", None)
+                continue
             stages = {"sample": float(sample_dt)}
             try:
                 with flightrec.activate(req.trace):
+                    _CHAOS_CPU()
                     out = self._infer_presampled(req, batch, stages)
+                br.record_success()
                 t_done = time.perf_counter()
                 self._finish(req, out, lane="cpu", stages=stages,
                              t_done=t_done)
             except Exception as e:  # noqa: BLE001 — lane must survive
-                telemetry.counter("serving_requests_total",
-                                  lane="cpu", status="error").inc()
-                self._finish_error(req, e, lane="cpu")
-                self.result_queue.put((req, e))
+                br.record_failure()
+                self._failover([req], "cpu", e)
+
+    # -- failover -------------------------------------------------------
+    def _failover(self, reqs, lane: str, error: Optional[Exception]):
+        """Reroute requests off a failed (or breaker-open) lane.  Every
+        request is ANSWERED: rerouted and finished, or — when no route
+        exists / the reroute itself fails — errored on the result queue.
+        ``error`` is the primary-lane failure (None when the breaker
+        shorted the attempt)."""
+        for r in reqs:
+            if shed_if_expired(r, self.result_queue, lane):
+                continue
+            try:
+                done = (self._failover_via_cpu(r) if lane == "device"
+                        else self._failover_via_device(r))
+            except Exception as e:  # noqa: BLE001 — failover can fail too
+                self._answer_error(r, e, "failover")
+                continue
+            if not done:
+                self._answer_error(
+                    r, error if error is not None else LaneUnavailable(lane),
+                    lane)
+
+    def _failover_via_cpu(self, req) -> bool:
+        """Serve one device-lane request inline on the CPU sampler lane.
+        False when no ``cpu_sampler`` was wired (no route)."""
+        if self.cpu_sampler is None:
+            return False
+        stages: dict = {}
+        with flightrec.activate(req.trace):
+            if flightrec.tracing():
+                flightrec.event("failover", {"from": "device", "to": "cpu"})
+            ids = np.asarray(req.ids)
+            t0 = time.perf_counter()
+            padded = self._pad_ids(ids) if len(ids) <= self.BUCKETS[-1] \
+                else ids
+            batch = self.cpu_sampler.sample(padded)
+            stages["sample"] = time.perf_counter() - t0
+            out = self._infer_presampled(req, batch, stages)
+        telemetry.counter("serving_failover_total",
+                          direction="device_to_cpu").inc()
+        self._finish(req, out, lane="failover", stages=stages,
+                     t_done=time.perf_counter())
+        return True
+
+    def _failover_via_device(self, req) -> bool:
+        """Serve one CPU-lane request via the bucketed device forward.
+        False when the device breaker refuses it (no route)."""
+        if not self._breakers["device"].allow():
+            return False
+        stages: dict = {}
+        with flightrec.activate(req.trace):
+            if flightrec.tracing():
+                flightrec.event("failover", {"from": "cpu", "to": "device"})
+            ids = np.asarray(req.ids)
+            out = self._run_bucketed(ids, stages)[: len(ids)]
+        telemetry.counter("serving_failover_total",
+                          direction="cpu_to_device").inc()
+        self._finish(req, out, lane="failover", stages=stages,
+                     t_done=time.perf_counter())
+        return True
+
+    def _answer_error(self, req, exc, lane: str):
+        telemetry.counter("serving_requests_total", lane=lane,
+                          status="error").inc()
+        self._finish_error(req, exc, lane=lane)
+        self.result_queue.put((req, exc))
 
     def _finish(self, req, out, lane: str = "device",
                 stages: Optional[dict] = None,
@@ -592,8 +802,8 @@ class InferenceServer:
         self.device_q.put(_STOP)
         if self.cpu_q is not None:
             self.cpu_q.put(_STOP)
-        for t in self._threads:
-            t.join(timeout=10)
+        leaked = join_and_reap(self._threads, timeout=10.0,
+                               component="server")
         srv = getattr(self, "_metrics_server", None)
         if srv is not None:
             srv.close()
@@ -602,6 +812,7 @@ class InferenceServer:
         if wd is not None:
             wd.stop()
             self._slo_watchdog = None
+        return leaked
 
 
 def calibrate_threshold(tpu_sampler, cpu_sampler, feature, apply_fn, params,

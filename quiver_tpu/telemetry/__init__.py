@@ -116,3 +116,6 @@ def reset() -> None:
     slo = sys.modules.get(__name__ + ".slo")
     if slo is not None:
         slo.reset()
+    br = sys.modules.get("quiver_tpu.resilience.breaker")
+    if br is not None:
+        br.reset()

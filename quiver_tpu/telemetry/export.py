@@ -14,9 +14,10 @@ Three views:
     ``/metrics`` (text), ``/metrics.json``, ``/trace.json`` (Chrome
     trace events, Perfetto-loadable), plus the flight-recorder debug
     surface: ``/debug/requests`` (retained-request summaries),
-    ``/debug/requests/<trace_id>`` (one full event log), and
-    ``/debug/slo`` (watchdog objective status).  ``HEAD`` answers every
-    route with the headers its ``GET`` would carry.
+    ``/debug/requests/<trace_id>`` (one full event log), ``/debug/slo``
+    (watchdog objective status), and ``/debug/breakers`` (per-lane
+    circuit-breaker states).  ``HEAD`` answers every route with the
+    headers its ``GET`` would carry.
 """
 
 from __future__ import annotations
@@ -146,6 +147,11 @@ class MetricsServer:
                     from .slo import get_watchdog
 
                     return (json.dumps(get_watchdog().status(), indent=2),
+                            "application/json")
+                if path.startswith("/debug/breakers"):
+                    from ..resilience.breaker import breakers_status
+
+                    return (json.dumps(breakers_status(), indent=2),
                             "application/json")
                 return None
 

@@ -252,10 +252,12 @@ class FlightRecorder:
     Fixed capacity (``config.flightrec_capacity``): retaining a record
     past capacity evicts the oldest, so steady-state memory is
     O(capacity x events-per-trace) no matter how long the server runs.
-    Retention reasons, in precedence order: ``error`` (the request
-    failed), ``flagged`` (explicitly marked), ``slow`` (end-to-end above
-    ``config.flightrec_slow_ms``).  Everything else is discarded at
-    finish and only ticks ``flightrec_dropped_total``.
+    Retention reasons, in precedence order: any non-ok status verbatim
+    (``error`` — the request failed; ``shed`` — admission control or a
+    deadline dropped it; ``rejected`` — the payload never parsed),
+    then ``flagged`` (explicitly marked), then ``slow`` (end-to-end
+    above ``config.flightrec_slow_ms``).  Everything else is discarded
+    at finish and only ticks ``flightrec_dropped_total``.
     """
 
     _guarded_by = {"_ring": "_lock", "_by_id": "_lock"}
@@ -280,7 +282,7 @@ class FlightRecorder:
     def classify(self, ctx: TraceContext, e2e_seconds: float,
                  status: str) -> Optional[str]:
         if status != "ok":
-            return "error"
+            return status  # error / shed / rejected — all worth keeping
         if ctx.flagged:
             return "flagged"
         if e2e_seconds > self.slow_threshold_s:

@@ -63,6 +63,13 @@ def _repo_copy_with(tmp_path, relpath, appended):
     ("quiver_tpu/serving.py", "QT006",
      "\n\ndef _bad_metric(bucket):\n"
      "    telemetry.counter(f\"serving_bucket_{bucket}_total\").inc()\n"),
+    ("quiver_tpu/serving.py", "QT007",
+     "\n\ndef _doomed_loop(q):\n"
+     "    while True:\n"
+     "        try:\n"
+     "            q.get()\n"
+     "        except Exception:\n"
+     "            continue\n"),
 ])
 def test_injected_violation_fails_cli(tmp_path, relpath, code, appended):
     root = _repo_copy_with(tmp_path, relpath, appended)

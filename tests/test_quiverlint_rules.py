@@ -380,6 +380,107 @@ class TestMetricNames:
         assert r.findings == []
 
 
+# ------------------------------------------------------------ QT007
+class TestSilentExcept:
+    def test_flags_swallowed_exception_in_loop(self, tmp_path):
+        r = run_lint(tmp_path, """
+            def _worker(q):
+                while True:
+                    try:
+                        q.get_nowait()
+                    except Exception:
+                        pass
+        """, hot_modules=ALL_HOT)
+        assert codes(r) == ["QT007"]
+        assert "swallows" in r.findings[0].message
+
+    def test_flags_bare_except_without_forwarding(self, tmp_path):
+        r = run_lint(tmp_path, """
+            def _device_loop(q, n):
+                for _ in range(n):
+                    try:
+                        q.get()
+                    except BaseException as e:
+                        n = 0  # drops e on the floor
+        """, hot_modules=ALL_HOT)
+        assert codes(r) == ["QT007"]
+
+    def test_recording_via_telemetry_is_clean(self, tmp_path):
+        r = run_lint(tmp_path, """
+            from quiver_tpu import telemetry
+
+            def _loop(q):
+                while True:
+                    try:
+                        q.get()
+                    except Exception:
+                        telemetry.counter("worker_errors_total").inc()
+        """, hot_modules=ALL_HOT)
+        assert r.findings == []
+
+    def test_forwarding_the_exception_is_clean(self, tmp_path):
+        # self._reject(item, e) / results.put((e, ...)) both forward the
+        # exception object to a consumer — the serving/mixed idiom
+        r = run_lint(tmp_path, """
+            class B:
+                def _worker(self, q):
+                    while True:
+                        item = q.get()
+                        try:
+                            self.route(item)
+                        except Exception as e:
+                            self._reject(item, e)
+
+            def worker(q, results):
+                while True:
+                    try:
+                        q.get()
+                    except BaseException as e:
+                        results.put((e, "error"))
+        """, hot_modules=ALL_HOT)
+        assert r.findings == []
+
+    def test_reraise_is_clean(self, tmp_path):
+        r = run_lint(tmp_path, """
+            def run(q):
+                try:
+                    q.get()
+                except Exception:
+                    raise
+        """, hot_modules=ALL_HOT)
+        assert r.findings == []
+
+    def test_narrow_handler_and_non_loop_fn_are_exempt(self, tmp_path):
+        # queue.Empty is control flow; a swallow outside the thread-loop
+        # naming convention is left to review
+        r = run_lint(tmp_path, """
+            import queue
+
+            def _drain(q):
+                try:
+                    return q.get_nowait()
+                except queue.Empty:
+                    return None
+
+            def probe(x):
+                try:
+                    return x.value
+                except Exception:
+                    return None
+        """, hot_modules=ALL_HOT)
+        assert r.findings == []
+
+    def test_cold_module_is_exempt(self, tmp_path):
+        r = run_lint(tmp_path, """
+            def _worker(q):
+                try:
+                    q.get()
+                except Exception:
+                    pass
+        """, name="cold.py", hot_modules=("hot_*.py",))
+        assert r.findings == []
+
+
 # ------------------------------------------------ suppression plumbing
 class TestSuppression:
     def test_same_line_suppression(self, tmp_path):

@@ -1,0 +1,493 @@
+"""Resilience suite: deadlines, load shedding, circuit breaking, lane
+failover, and the deterministic chaos harness (``make chaos``).
+
+Every fault here is *scripted* — a seeded :class:`ChaosPlan` or an
+injected fake clock — so the suite is deterministic: the same plan over
+the same request sequence leaves identical shed / retry / degraded
+counters and an identical replay log (asserted explicitly below).
+"""
+
+import queue
+import threading
+import time
+
+import numpy as np
+import jax
+import pytest
+
+import quiver_tpu.config as config_mod
+from quiver_tpu import (
+    Feature, GraphSageSampler, InferenceServer, RequestBatcher, telemetry,
+)
+from quiver_tpu.serving import ServingRequest, _STOP
+from quiver_tpu.telemetry import flightrec, metric_key
+from quiver_tpu.resilience import (
+    BoundedLane, ChaosFault, ChaosPlan, CircuitBreaker, DeadlineExceeded,
+    LoadShed, PeerTimeout, breakers_status, join_and_reap,
+)
+from quiver_tpu.resilience import chaos
+
+pytestmark = pytest.mark.chaos
+
+NHOSTS = 8
+
+_CFG_KEYS = (
+    "serving_deadline_ms", "serving_queue_depth",
+    "serving_queue_high_watermark", "serving_queue_low_watermark",
+    "serving_breaker_failures", "serving_breaker_reset_s",
+    "serving_breaker_probes", "flightrec_slow_ms",
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_resilience():
+    """Fresh registry/recorder/breakers per test; config restored, and
+    no chaos plan may leak across tests."""
+    cfg = config_mod.get_config()
+    saved = {k: getattr(cfg, k) for k in _CFG_KEYS}
+    telemetry.set_enabled(True)
+    telemetry.reset()
+    yield
+    chaos.uninstall()
+    config_mod.update(**saved)
+    telemetry.set_enabled(True)
+    telemetry.reset()
+
+
+def counter_value(name, **labels):
+    return telemetry.snapshot()["counters"].get(metric_key(name, labels), 0)
+
+
+def _req(ids=(1, 2), seq=0, priority=0, deadline=None):
+    return ServingRequest(ids=np.asarray(ids, dtype=np.int64), client=0,
+                          seq=seq, priority=priority, deadline=deadline)
+
+
+# ===================================================== BoundedLane
+def test_lane_overflow_sheds_lowest_priority_first():
+    rq = queue.Queue()
+    lane = BoundedLane("device", maxsize=4, high=1.0, low=0.5,
+                       result_queue=rq)
+    for i in range(4):
+        lane.put(_req(seq=i, priority=1))
+    # arrival at capacity with no lower-priority victim: arrival sheds
+    lane.put(_req(seq=4, priority=0))
+    req, exc = rq.get_nowait()
+    assert req.seq == 4 and isinstance(exc, LoadShed)
+    assert exc.reason == "overflow"
+    # higher-priority arrival displaces the oldest lower-priority one
+    lane.put(_req(seq=5, priority=2))
+    req, exc = rq.get_nowait()
+    assert req.seq == 0 and isinstance(exc, LoadShed)
+    assert lane.qsize() == 4
+    kept = [lane.get_nowait().seq for _ in range(4)]
+    assert kept == [1, 2, 3, 5]
+    assert counter_value("serving_shed_total", reason="overflow",
+                         lane="device") == 2
+
+
+def test_lane_watermark_hysteresis():
+    rq = queue.Queue()
+    lane = BoundedLane("cpu", maxsize=10, high=0.5, low=0.2,
+                       result_queue=rq)  # high=5, low=2
+    for i in range(5):
+        lane.put(_req(seq=i))
+    assert not lane.shedding
+    lane.put(_req(seq=5))  # depth 5 >= high: engages shedding, sheds
+    assert lane.shedding
+    req, exc = rq.get_nowait()
+    assert req.seq == 5 and exc.reason == "watermark"
+    # still above low: sheds persist even though depth < maxsize
+    lane.put(_req(seq=6))
+    assert rq.get_nowait()[0].seq == 6
+    # drain below low releases shedding; admissions resume
+    while lane.qsize() > 1:
+        lane.get_nowait()
+    lane.put(_req(seq=7))
+    assert not lane.shedding
+    assert lane.get_nowait().seq in (4, 7)
+    assert counter_value("serving_shed_total", reason="watermark",
+                         lane="cpu") == 2
+
+
+def test_lane_sheds_expired_request_at_get():
+    rq = queue.Queue()
+    lane = BoundedLane("device", maxsize=8, result_queue=rq)
+    expired = _req(seq=0, deadline=time.perf_counter() - 0.01)
+    live = _req(seq=1)
+    lane.put(expired)
+    lane.put(live)
+    got = lane.get_nowait()  # expired one shed on the spot
+    assert got.seq == 1
+    req, exc = rq.get_nowait()
+    assert req.seq == 0 and isinstance(exc, DeadlineExceeded)
+    assert counter_value("serving_shed_total", reason="deadline",
+                         lane="device") == 1
+
+
+def test_lane_control_items_always_admitted():
+    rq = queue.Queue()
+    lane = BoundedLane("device", maxsize=2, high=1.0, low=0.5,
+                       result_queue=rq)
+    lane.put(_req(seq=0))
+    lane.put(_req(seq=1))
+    lane.put(_STOP)  # at capacity — the sentinel must still go through
+    assert lane.qsize() == 3
+    assert rq.empty()
+
+
+def test_lane_without_result_queue_never_drops():
+    lane = BoundedLane("cpu", maxsize=2, high=1.0, low=0.5)
+    for i in range(5):  # no way to answer a shed: admit past capacity
+        lane.put(_req(seq=i))
+    assert [lane.get_nowait().seq for _ in range(5)] == [0, 1, 2, 3, 4]
+
+
+# ===================================================== CircuitBreaker
+def test_breaker_lifecycle_scripted_clock():
+    clk = {"t": 0.0}
+    br = CircuitBreaker("test.lane", failure_threshold=2,
+                        reset_timeout_s=10.0, half_open_probes=1,
+                        clock=lambda: clk["t"])
+    gauge_key = metric_key("serving_breaker_state", {"lane": "test.lane"})
+
+    assert br.allow() and br.state == "closed"
+    br.record_failure()
+    assert br.state == "closed"  # below threshold
+    br.record_failure()
+    assert br.state == "open" and not br.allow()
+    assert telemetry.snapshot()["gauges"][gauge_key] == 2
+
+    clk["t"] += 9.9
+    assert not br.allow()  # timeout not yet elapsed
+    clk["t"] += 0.2
+    assert br.allow()  # -> half-open, first probe admitted
+    assert br.state == "half_open"
+    assert not br.allow()  # probe budget (1) exhausted
+
+    br.record_failure()  # probe failed: back to open, timer restarts
+    assert br.state == "open" and not br.allow()
+    clk["t"] += 10.1
+    assert br.allow() and br.state == "half_open"
+    br.record_success()
+    assert br.state == "closed" and br.allow()
+    assert telemetry.snapshot()["gauges"][gauge_key] == 0
+
+    status = breakers_status()["breakers"]
+    mine = [b for b in status if b["lane"] == "test.lane"]
+    assert mine and mine[0]["state"] == "closed"
+    assert counter_value("serving_breaker_transitions_total",
+                         lane="test.lane", to="open") == 2
+
+
+# ===================================================== chaos harness
+def test_chaos_plan_replays_byte_identical():
+    def run():
+        telemetry.reset()
+        plan = (ChaosPlan(seed=42)
+                .fail("p.crash", times=2, after=1)
+                .fail("p.flaky", exc=ValueError, times=None, rate=0.3))
+        crash, flaky = chaos.point("p.crash"), chaos.point("p.flaky")
+        outcomes = []
+        with chaos.active(plan):
+            for _ in range(20):
+                for pt in (crash, flaky):
+                    try:
+                        pt()
+                        outcomes.append("ok")
+                    except Exception as e:  # noqa: BLE001 — recording
+                        outcomes.append(type(e).__name__)
+        counters = {
+            p: counter_value("chaos_injections_total", point=p)
+            for p in ("p.crash", "p.flaky")
+        }
+        return outcomes, plan.log(), counters
+
+    first, second = run(), run()
+    assert first == second  # byte-identical replay
+    outcomes, log, counters = first
+    assert outcomes.count("ChaosFault") == 2 == counters["p.crash"]
+    assert outcomes.count("ValueError") == counters["p.flaky"] > 0
+    # hits 1 and 2 of p.crash raise; hit 0 passes
+    crash_actions = [a for (n, _, a) in log if n == "p.crash"]
+    assert crash_actions[:3] == ["pass", "raise:ChaosFault",
+                                 "raise:ChaosFault"]
+
+
+def test_chaos_point_is_noop_without_plan():
+    assert chaos.current_plan() is None
+    chaos.point("nowhere.installed")()  # must not raise, tick, or log
+    assert counter_value("chaos_injections_total",
+                         point="nowhere.installed") == 0
+
+
+# ===================================================== serving failover
+def _serving_stack(small_graph, rng, **server_kw):
+    n = small_graph.node_count
+    feat = rng.normal(size=(n, 4)).astype(np.float32)
+    feature = Feature(device_cache_size="1G").from_cpu_tensor(feat)
+    sampler = GraphSageSampler(small_graph, [3])
+    from quiver_tpu.models import GraphSAGE
+
+    model = GraphSAGE(hidden=8, out_dim=2, num_layers=1, dropout=0.0)
+    b0 = sampler.sample(np.arange(8, dtype=np.int64))
+    params = model.init(jax.random.PRNGKey(0),
+                        feature[np.asarray(b0.n_id)], b0.layers)
+    apply_fn = lambda p, x, blocks: model.apply(p, x, blocks)
+    dq = queue.Queue()
+    server = InferenceServer(sampler, feature, apply_fn, params, dq,
+                             max_coalesce=1, **server_kw)
+    return dq, server
+
+
+def test_device_crash_fails_over_to_cpu_zero_lost(small_graph, rng):
+    """An injected device-lane crash completes every in-flight request
+    via the CPU sampler lane — none lost, none errored."""
+    cpu_sampler = GraphSageSampler(small_graph, [3], mode="CPU")
+    dq, server = _serving_stack(small_graph, rng, cpu_sampler=cpu_sampler)
+    server.start()
+    plan = ChaosPlan(seed=7).fail("serving.device_lane", times=3)
+    n_req = 6
+    try:
+        with chaos.active(plan):
+            for i in range(n_req):
+                dq.put(_req(ids=rng.integers(0, small_graph.node_count, 5),
+                            seq=i))
+            got = {}
+            for _ in range(n_req):
+                req, out = server.result_queue.get(timeout=60)
+                got[req.seq] = out
+    finally:
+        server.stop()
+    assert sorted(got) == list(range(n_req))
+    for seq, out in got.items():
+        assert not isinstance(out, Exception), (seq, out)
+        assert out.shape == (5, 2)
+    assert plan.hits("serving.device_lane") == n_req
+    assert counter_value("serving_failover_total",
+                         direction="device_to_cpu") == 3
+    assert counter_value("chaos_injections_total",
+                         point="serving.device_lane") == 3
+
+
+def test_device_crash_without_route_answers_errors(small_graph, rng):
+    """No cpu_sampler wired: the crash is answered as a typed error (the
+    pre-failover contract) — still nothing lost or wedged."""
+    dq, server = _serving_stack(small_graph, rng)  # no cpu_sampler
+    server.start()
+    plan = ChaosPlan(seed=7).fail("serving.device_lane", times=1)
+    try:
+        with chaos.active(plan):
+            dq.put(_req(ids=np.array([1, 2, 3]), seq=0))
+            dq.put(_req(ids=np.array([4, 5]), seq=1))
+            r = {}
+            for _ in range(2):
+                req, out = server.result_queue.get(timeout=60)
+                r[req.seq] = out
+    finally:
+        server.stop()
+    assert isinstance(r[0], ChaosFault)
+    assert r[1].shape == (2, 2)
+
+
+def test_breaker_open_reroutes_without_touching_device(small_graph, rng):
+    """With the device breaker held open every device-lane request takes
+    the CPU failover route — the device pass never runs."""
+    cpu_sampler = GraphSageSampler(small_graph, [3], mode="CPU")
+    dq, server = _serving_stack(small_graph, rng, cpu_sampler=cpu_sampler)
+    # trip the breaker before any traffic
+    for _ in range(server._breakers["device"].failure_threshold):
+        server._breakers["device"].record_failure()
+    assert server._breakers["device"].state == "open"
+    server.start()
+    try:
+        dq.put(_req(ids=np.array([1, 2, 3]), seq=0))
+        req, out = server.result_queue.get(timeout=60)
+    finally:
+        server.stop()
+    assert not isinstance(out, Exception), out
+    assert out.shape == (3, 2)
+    assert counter_value("serving_failover_total",
+                         direction="device_to_cpu") == 1
+
+
+# ===================================================== deadlines e2e
+def test_deadline_shed_ticks_metric_and_retains_record():
+    config_mod.update(serving_deadline_ms=5.0)
+    telemetry.reset()
+    rq = queue.Queue()
+    lane = BoundedLane("device", maxsize=8, result_queue=rq)
+    req = _req(seq=0)  # picks up the 5ms budget from config
+    assert req.deadline is not None
+    lane.put(req)
+    time.sleep(0.02)  # let it expire on the queue
+    with pytest.raises(queue.Empty):
+        lane.get_nowait()
+    shed_req, exc = rq.get_nowait()
+    assert shed_req is req and isinstance(exc, DeadlineExceeded)
+    assert exc.elapsed_ms >= exc.budget_ms
+    assert counter_value("serving_shed_total", reason="deadline",
+                         lane="device") == 1
+    rec = flightrec.get_recorder().get(req.trace.trace_id)
+    assert rec is not None
+    assert rec["status"] == "shed" and rec["reason"] == "shed"
+    assert any(e["name"] == "shed" for e in rec["events"])
+
+
+def test_batcher_sheds_expired_at_route():
+    config_mod.update(serving_deadline_ms=1.0)
+    telemetry.reset()
+    stream, rq = queue.Queue(), queue.Queue()
+    rb = RequestBatcher([stream], mode="CPU", result_queue=rq)
+    req = _req(seq=0)
+    time.sleep(0.01)
+    rb.start()
+    try:
+        stream.put(req)
+        shed_req, exc = rq.get(timeout=10)
+    finally:
+        assert rb.stop() == []
+    assert shed_req is req and isinstance(exc, DeadlineExceeded)
+    assert counter_value("serving_shed_total", reason="deadline",
+                         lane="batcher") == 1
+
+
+# ===================================================== batcher rejects
+def test_malformed_payload_rejected_thread_survives():
+    stream, rq = queue.Queue(), queue.Queue()
+    rb = RequestBatcher([stream], mode="CPU", result_queue=rq)
+    rb.start()
+    try:
+        stream.put(3.5)  # scalar payload: not coercible to an ids batch
+        good = _req(ids=np.array([1, 2]), seq=1)
+        stream.put(good)
+        routed = rb.cpu_batched_queue.get(timeout=10)
+        assert routed is good  # the stream thread survived the reject
+        deadline = time.time() + 5
+        while (counter_value("serving_rejected_total") < 1
+               and time.time() < deadline):
+            time.sleep(0.01)
+        assert counter_value("serving_rejected_total") == 1
+    finally:
+        assert rb.stop() == []  # no leaked threads
+    summaries = flightrec.get_recorder().summaries()
+    assert any(s["status"] == "rejected" for s in summaries)
+
+
+# ===================================================== shutdown reaping
+def test_join_and_reap_reports_wedged_thread():
+    gate = threading.Event()
+    t = threading.Thread(target=gate.wait, daemon=True)
+    t.start()
+    leaked = join_and_reap([t], timeout=0.05, component="unittest")
+    assert leaked == [t]
+    assert counter_value("serving_thread_leak_total",
+                         component="unittest") == 1
+    gate.set()
+    t.join(timeout=5)
+
+
+def test_prefetcher_stop_with_wedged_consumer():
+    from quiver_tpu.parallel.prefetch import Prefetcher
+
+    p = Prefetcher(range(100), lambda i: i, depth=2)
+    it = iter(p)
+    assert next(it) == 0
+    # the consumer wedges here: it never drains again, so the worker is
+    # parked on the full bounded queue.  stop() must still unwind it.
+    time.sleep(0.05)
+    p.stop()
+    deadline = time.time() + 5
+    while p._thread.is_alive() and time.time() < deadline:
+        time.sleep(0.01)
+    assert not p._thread.is_alive()
+    it.close()
+    assert counter_value("serving_thread_leak_total",
+                         component="prefetcher") == 0
+
+
+# ===================================================== dist degradation
+@pytest.fixture(scope="module")
+def mesh():
+    from quiver_tpu.utils.mesh import make_mesh
+
+    assert jax.device_count() == NHOSTS
+    return make_mesh(("data",))
+
+
+def test_dist_feature_degrades_to_local_rows(mesh, rng):
+    from quiver_tpu.dist import DistFeature, PartitionInfo
+
+    n, d = 256, 8
+    full = rng.normal(size=(n, d)).astype(np.float32)
+    g2h = rng.integers(0, NHOSTS, n).astype(np.int32)
+    rep = np.arange(0, 16)  # hottest rows replicated everywhere
+    info = PartitionInfo(host=0, hosts=NHOSTS, global2host=g2h,
+                         replicate=rep)
+    df = DistFeature.from_global_feature(full, mesh, info)
+    ids = rng.integers(0, n, (NHOSTS, 32)).astype(np.int32)
+
+    plan = ChaosPlan(seed=3).fail("dist.feature.exchange",
+                                  exc=PeerTimeout, times=1)
+    with chaos.active(plan):
+        out = np.asarray(df.lookup(ids))
+    assert df.last_degraded
+    mask = df.last_degraded_mask
+    # exactly the locally answerable rows: owned by the row's host or
+    # replicated everywhere (no overlay is enabled in this fixture)
+    expected = (info.replicate_mask[ids]
+                | (info.global2host[ids]
+                   == np.arange(NHOSTS)[:, None]))
+    np.testing.assert_array_equal(mask, expected)
+    np.testing.assert_allclose(out[mask], full[ids[mask]], rtol=1e-6)
+    assert (out[~mask] == 0).all()
+    assert counter_value("dist_feature_degraded_total") == 1
+
+    # the next call (fault cleared) is whole again
+    out2 = np.asarray(df.lookup(ids))
+    assert not df.last_degraded
+    for h in range(NHOSTS):
+        np.testing.assert_allclose(out2[h], full[ids[h]], rtol=1e-6)
+
+
+def test_dist_sampler_retries_exchange_once(small_graph, mesh):
+    from quiver_tpu.dist.sampler import DistGraphSampler
+
+    s = DistGraphSampler(small_graph, mesh, sizes=[3])
+    seeds = np.random.default_rng(0).integers(
+        0, small_graph.node_count, (NHOSTS, 8))
+    plan = ChaosPlan(seed=5).fail("dist.sampler.exchange",
+                                  exc=PeerTimeout, times=1)
+    with chaos.active(plan):
+        n_id, n_mask, num, blocks = s.sample(seeds, key=7)
+    np.testing.assert_array_equal(np.asarray(n_id)[:, :8], seeds)
+    assert counter_value("dist_sampler_retries_total") == 1
+
+    # two consecutive faults exhaust the single retry and surface
+    plan2 = ChaosPlan(seed=5).fail("dist.sampler.exchange",
+                                   exc=PeerTimeout, times=2)
+    with chaos.active(plan2), pytest.raises(PeerTimeout):
+        s.sample(seeds, key=7)
+
+
+# ===================================================== steady-state cost
+@pytest.mark.retrace_budget(0)
+def test_disabled_checks_add_no_jit_builds():
+    """QUIVER_TELEMETRY=off + no chaos plan + no deadline: the whole
+    resilience surface — injection points, deadline checks, bounded
+    lanes, breaker gates — builds zero jit executables and never touches
+    jax (the retrace-budget guard enforces the zero)."""
+    telemetry.set_enabled(False)
+    try:
+        lane = BoundedLane("device", maxsize=16, result_queue=queue.Queue())
+        pt = chaos.point("serving.device_lane")
+        br = CircuitBreaker("cost.lane", failure_threshold=3)
+        for i in range(64):
+            pt()  # disabled: one module-global read
+            r = _req(seq=i)
+            assert r.deadline is None and r.trace is None
+            lane.put(r)
+            assert br.allow()
+            assert lane.get_nowait().seq == i
+    finally:
+        telemetry.set_enabled(True)
