@@ -1,6 +1,6 @@
 # Top-level convenience targets (parity: reference ./configure && make).
 .PHONY: all native test test-quick test-native asan bench smoke \
-	telemetry-check chaos lint help
+	telemetry-check chaos stream lint help
 
 all: native
 
@@ -33,9 +33,13 @@ telemetry-check:
 chaos:
 	python -m pytest tests/ -m chaos -q
 
+# delta-CSR overlay / temporal sampling / ingestion suite (docs/STREAMING.md)
+stream:
+	python -m pytest tests/ -m stream -q
+
 # quiverlint: hot-path static analysis (docs/STATIC_ANALYSIS.md)
 lint:
 	python -m quiver_tpu.analysis quiver_tpu bench.py
 
 help:
-	@echo "targets: native | test | test-quick | test-native | asan | bench | smoke | telemetry-check | chaos | lint"
+	@echo "targets: native | test | test-quick | test-native | asan | bench | smoke | telemetry-check | chaos | stream | lint"

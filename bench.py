@@ -1162,6 +1162,74 @@ def bench_serving_resilience(topo, dim, classes, n_requests=300,
     return st
 
 
+def bench_stream_ingest(topo, batch=1024, fanout=FANOUT, iters=20,
+                        gather_mode="auto"):
+    """Streaming-overlay A/B: sampling latency as the delta overlay
+    grows, against the frozen-CSR sampler on the same graph.
+
+    The delta-CSR design note (docs/STREAMING.md): sampling cost should
+    be flat in the *number* of pending deltas (the overlay adds one
+    fused gather over the delta table, whose padded size is what
+    matters), and compaction — the pause that folds the overlay away —
+    is a background CSR rebuild, not a stop-the-world on samplers.
+    Reported per pending level: per-sample p50/p99, plus the measured
+    ``compact()`` pause at the deepest level."""
+    import numpy as _np
+
+    from quiver_tpu import CSRTopo, GraphSageSampler
+    from quiver_tpu.stream import StreamingGraph, compact
+
+    levels = (0, 1_000, 100_000)
+    rng = _np.random.default_rng(7)
+    seeds = rng.integers(0, topo.node_count, size=batch).astype(_np.int64)
+
+    def timed(sampler, tag):
+        sampler.sample(seeds, key=_mk(0)).n_id.block_until_ready()
+        ts = []
+        for r in range(iters):
+            t0 = time.perf_counter()
+            sampler.sample(seeds, key=_mk(1 + r)).n_id.block_until_ready()
+            ts.append((time.perf_counter() - t0) * 1e3)
+        ts.sort()
+        out = dict(p50_ms=round(ts[len(ts) // 2], 3),
+                   p99_ms=round(ts[min(len(ts) - 1,
+                                       int(len(ts) * 0.99))], 3))
+        log(f"stream_ingest[{tag}]: p50 {out['p50_ms']} ms "
+            f"p99 {out['p99_ms']} ms")
+        return out
+
+    frozen = GraphSageSampler(topo, sizes=fanout, dedup="none",
+                              gather_mode=gather_mode)
+    st = dict(batch=batch, fanout=fanout, iters=iters,
+              gather_mode=frozen.gather_mode,
+              frozen=timed(frozen, "frozen"), pending={})
+
+    g = StreamingGraph(
+        CSRTopo(indptr=_np.asarray(topo.indptr),
+                indices=_np.asarray(topo.indices)),
+        delta_capacity=levels[-1] + 1024)
+    try:
+        sampler = GraphSageSampler(g, sizes=fanout,
+                                   gather_mode=gather_mode)
+        have = 0
+        for lvl in levels:
+            if lvl > have:
+                n_new = lvl - have
+                g.add_edges(rng.integers(0, g.node_count, n_new),
+                            rng.integers(0, g.node_count, n_new))
+                have = lvl
+            st["pending"][str(lvl)] = timed(sampler, f"pending={lvl}")
+        pause = compact(g)
+        st["compact_pause_ms"] = round(pause["pause_s"] * 1e3, 2)
+        st["compact_folded"] = pause["folded"]
+        st["post_compact"] = timed(sampler, "post-compact")
+        log(f"stream_ingest: compaction folded {pause['folded']:,} deltas "
+            f"in {st['compact_pause_ms']} ms")
+    finally:
+        g.close()
+    return st
+
+
 # ---------------------------------------------------------------- main
 def main():
     ap = argparse.ArgumentParser()
@@ -1171,7 +1239,7 @@ def main():
     ap.add_argument("--sections",
                     default="sampling,feature,feature_coldcache,e2e,"
                             "serving,serving_flightrec,"
-                            "serving_resilience,quality",
+                            "serving_resilience,stream_ingest,quality",
                     help="comma-separated subset to run")
     ap.add_argument("--ab-dedup", action="store_true",
                     help="also measure dedup='hop' for sampling + e2e")
@@ -1355,6 +1423,10 @@ def main():
         run_flightrec_section(gm_default)
     if "serving_resilience" in want:
         run_resilience_section(gm_default)
+    if "stream_ingest" in want:
+        runner.run("stream_ingest", 900,
+                   lambda: bench_stream_ingest(
+                       topo, batches[0], FANOUT, args.iters, gm_default))
 
     if "sampling" in want:
         if args.gather_mode or args.small:

@@ -93,6 +93,7 @@ _DEFAULT_HOT = (
     "quiver_tpu/ops/pallas/*.py",
     "quiver_tpu/parallel/*.py",
     "quiver_tpu/resilience/*.py",
+    "quiver_tpu/stream/*.py",
 )
 
 

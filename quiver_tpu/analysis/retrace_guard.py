@@ -153,6 +153,12 @@ def count_jit_builds():
         from quiver_tpu.sampler import GraphSageSampler
         patch(GraphSageSampler, "_build_jit",
               _count_calls(counter, "sampler._build_jit"))
+        # streaming overlay pipeline: builds key on snapshot SHAPES
+        # (B, epad, delta_bucket, has_ts, windowed) — steady-state
+        # ingestion must hit the same keys, which is exactly what this
+        # counter lets tests assert
+        patch(GraphSageSampler, "_build_stream_jit",
+              _count_calls(counter, "sampler._build_stream_jit"))
     except ImportError:
         pass
     try:

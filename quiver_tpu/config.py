@@ -112,6 +112,33 @@ class Config:
     slo_interval_s: float = field(
         default_factory=lambda: _env("SLO_INTERVAL_S", 5.0, float)
     )
+    # streaming tier (quiver_tpu.stream): delta-segment capacity before
+    # ingestion blocks on compaction, compactor cadence (seconds between
+    # periodic folds; the watermark triggers early when the pending
+    # fraction of capacity crosses it), and the edge-update ingestion
+    # lane (queue depth, its own deadline class — 0 = no deadline — and
+    # shed priority relative to query traffic)
+    stream_delta_capacity: int = field(
+        default_factory=lambda: _env("STREAM_DELTA_CAPACITY", 65536, int)
+    )
+    stream_compact_interval_s: float = field(
+        default_factory=lambda: _env("STREAM_COMPACT_INTERVAL_S", 30.0,
+                                     float)
+    )
+    stream_compact_watermark: float = field(
+        default_factory=lambda: _env("STREAM_COMPACT_WATERMARK", 0.75,
+                                     float)
+    )
+    stream_ingest_depth: int = field(
+        default_factory=lambda: _env("STREAM_INGEST_DEPTH", 256, int)
+    )
+    stream_ingest_deadline_ms: float = field(
+        default_factory=lambda: _env("STREAM_INGEST_DEADLINE_MS", 0.0,
+                                     float)
+    )
+    stream_ingest_priority: int = field(
+        default_factory=lambda: _env("STREAM_INGEST_PRIORITY", 1, int)
+    )
     # tracing
     trace: bool = field(default_factory=lambda: _env("TRACE", False, bool))
 

@@ -274,6 +274,31 @@ class DistFeature:
                 (rows, d), dtype=self._host_source.dtype)
         return self
 
+    def invalidate_rows(self, global_ids) -> int:
+        """Drop mutated rows (GLOBAL node ids) from this host's overlay.
+
+        Streaming mutations call this on every host (the overlay caches
+        remote rows, so the mutating host cannot know who holds a stale
+        copy — ``StreamingGraph.attach_feature`` wires the local store;
+        multi-host deployments broadcast the touched ids alongside the
+        edge updates themselves).  Same contract as
+        ``Feature.invalidate_rows``: resident slots drop, admission
+        evidence resets.  Returns overlay slots dropped.
+        """
+        from .. import telemetry
+
+        if self.cold_cache is None:
+            return 0
+        ids = np.atleast_1d(np.asarray(global_ids, dtype=np.int64))
+        with self._ov_lock:
+            cache = self.cold_cache
+            dropped = (cache.invalidate_rows(ids)
+                       if cache is not None else 0)
+        if dropped:
+            telemetry.counter("coldcache_invalidated_rows_total").inc(
+                dropped)
+        return dropped
+
     def _ov_patch_fn(self, B, bucket, me):
         """Cached per-(B, bucket) patch program: scatter overlay hits
         into this host's output row (pad pos = B, dropped)."""

@@ -344,6 +344,38 @@ class Feature:
                                       dtype=self._hot_dtype())
         return self
 
+    def invalidate_rows(self, node_ids) -> int:
+        """Drop mutated rows (OLD node ids) from the cold-row overlay.
+
+        The streaming tier calls this for every edge mutation's touched
+        endpoints (``StreamingGraph.attach_feature``): a resident
+        overlay slot would otherwise keep serving the pre-mutation
+        value.  Rows in the static hot prefix are untouched — that tier
+        is a partition of the table, not a cache, so staleness there is
+        a feature-*update* problem, not an invalidation one.  Touch
+        counts reset too: a mutated row re-earns admission from scratch
+        (miss on next touch, re-admit on the one after, under the
+        default second-touch policy).  Returns overlay slots dropped.
+        """
+        from . import telemetry
+
+        if self.cold_cache is None:
+            return 0
+        ids = np.atleast_1d(np.asarray(node_ids, dtype=np.int64))
+        if self.feature_order is not None:
+            ids = ids[(ids >= 0) & (ids < len(self.feature_order))]
+            ids = np.asarray(self.feature_order)[ids]
+        cold_ids = ids - self.cache_count
+        cold_ids = cold_ids[cold_ids >= 0]
+        with self._plock:
+            cache = self.cold_cache
+            dropped = (cache.invalidate_rows(cold_ids)
+                       if cache is not None else 0)
+        if dropped:
+            telemetry.counter("coldcache_invalidated_rows_total").inc(
+                dropped)
+        return dropped
+
     # ------------------------------------------------------------------
     def __getitem__(self, node_idx):
         """Gather rows by (old) node id; returns a device array.

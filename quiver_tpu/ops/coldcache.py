@@ -196,6 +196,36 @@ class ColdRowCache:
         return out
 
     # ------------------------------------------------------------------
+    def invalidate_rows(self, rows: np.ndarray) -> int:
+        """Drop the given cold-space rows from the overlay.
+
+        Called when the underlying feature rows mutate (stream edge/row
+        updates): a resident slot would otherwise keep serving the stale
+        value forever.  The freed slots keep ``ref=0``/``freq=0`` so the
+        next CLOCK sweep hands them out first; touch counts are also
+        reset so a mutated row must re-earn admission (second touch)
+        rather than re-admitting off pre-mutation evidence.
+
+        Returns the number of resident rows actually dropped.
+        """
+        rows = np.asarray(rows, dtype=np.int64)
+        if rows.size == 0:
+            return 0
+        rows = rows[(rows >= 0) & (rows < self.n_rows)]
+        if rows.size == 0:
+            return 0
+        slots = self.slot_of[rows]
+        live = slots >= 0
+        freed = slots[live]
+        if freed.size:
+            self.node_of[freed] = -1
+            self.freq[freed] = 0
+            self.ref[freed] = 0
+            self.slot_of[rows[live]] = -1
+        self.touches[rows] = 0
+        return int(freed.size)
+
+    # ------------------------------------------------------------------
     @property
     def resident(self) -> int:
         return int((self.node_of >= 0).sum())

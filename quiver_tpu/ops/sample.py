@@ -31,7 +31,8 @@ from typing import NamedTuple, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-__all__ = ["sample_neighbors", "SampleOut", "to_ragged"]
+__all__ = ["sample_neighbors", "sample_neighbors_overlay", "SampleOut",
+           "to_ragged"]
 
 
 class SampleOut(NamedTuple):
@@ -269,6 +270,110 @@ def sample_neighbors(
     # purpose (quiver.cu.hpp eid); PyG's Adj e_id slot can be filled from
     # this instead of the reference's empty tensor (sage_sampler.py:143).
     eid = jnp.where(mask, idx, jnp.int32(-1))
+    return SampleOut(nbrs=nbrs, mask=mask, counts=counts, eid=eid)
+
+
+@functools.partial(jax.jit, static_argnames=("k", "gather_mode",
+                                             "sample_rng", "windowed"))
+def sample_neighbors_overlay(
+    indptr: jax.Array,
+    indices: jax.Array,
+    tomb: jax.Array,
+    d_indptr: jax.Array,
+    d_indices: jax.Array,
+    seeds: jax.Array,
+    k: int,
+    key: jax.Array,
+    seed_mask: Optional[jax.Array] = None,
+    base_ts: Optional[jax.Array] = None,
+    d_ts: Optional[jax.Array] = None,
+    window_lo: Optional[jax.Array] = None,
+    window_hi: Optional[jax.Array] = None,
+    gather_mode: str = "xla",
+    sample_rng: str = "auto",
+    windowed: bool = False,
+) -> SampleOut:
+    """One-hop sampling over a base CSR **plus a delta-CSR overlay**.
+
+    The streaming tier (``quiver_tpu.stream``) layers pending edge
+    insertions (an append-only segment re-CSR'd per snapshot) and
+    deletions (a tombstone table over base edge positions) on the frozen
+    CSR.  This op draws from the **combined** neighborhood: a seed's
+    degree is ``base_deg + delta_deg`` and the stratified positions index
+    the virtual concatenation ``[base neighbors | delta neighbors]`` —
+    identical position math to :func:`sample_neighbors`, so with zero
+    deltas and no tombstones the outputs are bitwise identical to the
+    frozen path (the equivalence contract ``tests/test_stream.py``
+    enforces).
+
+    Deletion/window semantics are **rejection, not resampling**: a draw
+    landing on a tombstoned base edge (``tomb[pos] != 0``) or outside the
+    half-open timestamp window ``[window_lo, window_hi)`` is masked out,
+    so rows with many pending deletes can return fewer than
+    ``min(deg, k)`` neighbors until the compactor folds the deltas in.
+    That keeps the op one fused pass (no data-dependent second draw — a
+    retrace/perf hazard); the compactor restores exact fanout.
+
+    Args (beyond :func:`sample_neighbors`):
+      tomb: ``[E_pad]`` int32, nonzero = base edge position deleted.
+      d_indptr / d_indices: delta CSR over the same node-id space;
+        ``d_indices`` is padded to the snapshot's pow2 fanout bucket so
+        executable keys stay additive (coldcache discipline).
+      base_ts / d_ts: optional ``[E_pad]`` int32 per-edge timestamps
+        (required when ``windowed``).
+      window_lo / window_hi: traced int32 scalars — changing the window
+        does NOT retrace; only ``windowed`` (filter on/off) is static.
+
+    Delta draws report ``eid = indices.shape[0] + delta_pos`` so edge ids
+    stay unambiguous across the two segments.
+    """
+    seeds = seeds.astype(jnp.int32)
+    B = seeds.shape[0]
+    start = _gather(indptr, seeds, gather_mode)
+    end = _gather(indptr, seeds + 1, gather_mode)
+    bdeg = end - start
+    dstart = _gather(d_indptr, seeds, gather_mode)
+    dend = _gather(d_indptr, seeds + 1, gather_mode)
+    ddeg = dend - dstart
+    if seed_mask is not None:
+        bdeg = jnp.where(seed_mask, bdeg, 0)
+        ddeg = jnp.where(seed_mask, ddeg, 0)
+    deg = bdeg + ddeg
+
+    j = jnp.arange(k, dtype=jnp.int32)[None, :]              # [1, k]
+    u = _uniform(key, (B, k), sample_rng)
+    pos = _stratified_positions(u, deg, k)
+
+    # position < base_deg draws from the base segment, the rest from the
+    # delta segment (both index expressions clipped so the untaken side
+    # of the select still gathers in-bounds)
+    in_base = pos < bdeg[:, None]
+    bidx = start[:, None] + jnp.minimum(
+        pos, jnp.maximum(bdeg[:, None] - 1, 0))
+    dpos = jnp.maximum(pos - bdeg[:, None], 0)
+    didx = dstart[:, None] + dpos
+    nbrs = jnp.where(
+        in_base,
+        _gather(indices, bidx, gather_mode),
+        _gather(d_indices, didx, gather_mode),
+    )
+    live = jnp.where(
+        in_base, _gather(tomb, bidx, gather_mode) == 0, True)
+    if windowed:
+        ets = jnp.where(
+            in_base,
+            _gather(base_ts, bidx, gather_mode),
+            _gather(d_ts, didx, gather_mode),
+        )
+        live = live & (ets >= window_lo) & (ets < window_hi)
+    mask = (j < jnp.minimum(deg, k)[:, None]) & live
+    counts = mask.sum(axis=1).astype(jnp.int32)
+    nbrs = jnp.where(mask, nbrs, jnp.int32(-1))
+    eid = jnp.where(
+        mask,
+        jnp.where(in_base, bidx, jnp.int32(indices.shape[0]) + didx),
+        jnp.int32(-1),
+    )
     return SampleOut(nbrs=nbrs, mask=mask, counts=counts, eid=eid)
 
 

@@ -29,8 +29,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import telemetry
-from .ops.sample import (sample_neighbors, sample_neighbors_weighted,
-                         row_cumsum_weights)
+from .ops.sample import (sample_neighbors, sample_neighbors_overlay,
+                         sample_neighbors_weighted, row_cumsum_weights)
 from .ops.reindex import reindex
 from .utils.topology import CSRTopo
 
@@ -60,6 +60,8 @@ class SampledBatch(NamedTuple):
     drops: Optional[jax.Array] = None  # [L] per-hop frontier-cap drop
     # counts for THIS batch (overflow_stats(batch) reads it; the
     # sampler-level last_drops is unreliable under prefetching)
+    version: Optional[int] = None  # streaming: the graph version this
+    # batch sampled (the snapshot's), None on frozen-CSR samplers
 
     def to_pyg_adjs(self):
         """Ragged ``(n_id, batch_size, [Adj])`` view, PyG-compatible.
@@ -149,6 +151,52 @@ def _sample_pipeline_nodedup(indptr, indices, seeds, key, sizes,
     return frontier, fmask, num_nodes, tuple(blocks[::-1]), drops
 
 
+def _sample_pipeline_overlay(indptr, indices, tomb, d_indptr, d_indices,
+                             seeds, key, sizes, base_ts=None, d_ts=None,
+                             window_lo=None, window_hi=None,
+                             gather_mode="xla", return_eid=False,
+                             sample_rng="auto", windowed=False):
+    """Traced multi-hop pipeline over base CSR + delta overlay.
+
+    Structurally identical to :func:`_sample_pipeline_nodedup` (same key
+    split, same positional relabel), with the one-hop op swapped for
+    :func:`~quiver_tpu.ops.sample.sample_neighbors_overlay` — so with an
+    empty delta segment and no tombstones the outputs are bitwise
+    identical to the frozen positional pipeline (the streaming tier's
+    equivalence contract).
+    """
+    B = seeds.shape[0]
+    frontier = seeds.astype(jnp.int32)
+    fmask = jnp.ones((B,), dtype=bool)
+    blocks = []
+    keys = jax.random.split(key, len(sizes))
+    for l, k in enumerate(sizes):
+        out = sample_neighbors_overlay(
+            indptr, indices, tomb, d_indptr, d_indices, frontier, k,
+            keys[l], seed_mask=fmask, base_ts=base_ts, d_ts=d_ts,
+            window_lo=window_lo, window_hi=window_hi,
+            gather_mode=gather_mode, sample_rng=sample_rng,
+            windowed=windowed)
+        t = frontier.shape[0]
+        pos = (t + jnp.arange(t, dtype=jnp.int32)[:, None] * k
+               + jnp.arange(k, dtype=jnp.int32)[None, :])
+        blocks.append(
+            LayerBlock(
+                nbr_local=jnp.where(out.mask, pos, 0),
+                mask=out.mask,
+                num_targets=fmask.sum().astype(jnp.int32),
+                eid=out.eid if return_eid else None,
+            )
+        )
+        frontier = jnp.concatenate(
+            [frontier, jnp.where(out.mask, out.nbrs, 0).reshape(-1)]
+        )
+        fmask = jnp.concatenate([fmask, out.mask.reshape(-1)])
+    num_nodes = fmask.sum().astype(jnp.int32)
+    drops = jnp.zeros((len(sizes),), jnp.int32)
+    return frontier, fmask, num_nodes, tuple(blocks[::-1]), drops
+
+
 def _sample_pipeline(indptr, indices, seeds, key, sizes, caps,
                      gather_mode="xla", cum_weights=None,
                      return_eid=False, sample_rng="auto"):
@@ -199,11 +247,40 @@ def _sample_pipeline(indptr, indices, seeds, key, sizes, caps,
     return frontier, fmask, num_nodes, tuple(blocks[::-1]), jnp.stack(drops)
 
 
+def _is_stream_graph(obj) -> bool:
+    """Duck-typed StreamingGraph detection (no static import cycle):
+    anything exposing ``snapshot()`` + ``base`` samples via the overlay
+    pipeline."""
+    return hasattr(obj, "snapshot") and hasattr(obj, "base")
+
+
 def run_pipeline(dedup, indptr, indices, seeds, key, sizes, caps,
                  gather_mode="xla", cum_weights=None, return_eid=False,
-                 sample_rng="auto"):
+                 sample_rng="auto", overlay=None):
     """Dispatch to the dedup='none' or dedup='hop' traced pipeline — the
-    single place that mapping lives (sampler jit + fused train/eval)."""
+    single place that mapping lives (sampler jit + fused train/eval).
+
+    ``overlay`` (a dict of delta-CSR arrays + window scalars, see
+    ``GraphSageSampler._build_stream_jit``) routes to the streaming
+    overlay pipeline; it rides the positional (``dedup='none'``)
+    formulation only.
+    """
+    if overlay is not None:
+        if dedup != "none":
+            raise ValueError(
+                "overlay sampling rides the positional pipeline only "
+                f"(dedup='none'); got dedup={dedup!r}")
+        if cum_weights is not None:
+            raise ValueError("overlay sampling is uniform-only")
+        return _sample_pipeline_overlay(
+            indptr, indices, overlay["tomb"], overlay["d_indptr"],
+            overlay["d_indices"], seeds, key, sizes,
+            base_ts=overlay.get("base_ts"), d_ts=overlay.get("d_ts"),
+            window_lo=overlay.get("window_lo"),
+            window_hi=overlay.get("window_hi"),
+            gather_mode=gather_mode, return_eid=return_eid,
+            sample_rng=sample_rng,
+            windowed=bool(overlay.get("windowed", False)))
     if dedup == "none":
         return _sample_pipeline_nodedup(indptr, indices, seeds, key, sizes,
                                         gather_mode=gather_mode,
@@ -253,6 +330,19 @@ class GraphSageSampler:
         assert mode in ("TPU", "CPU", "UVA", "GPU"), mode
         if mode == "GPU":  # compat alias from the reference API
             mode = "TPU"
+        # streaming graphs (quiver_tpu.stream.StreamingGraph) are duck-
+        # typed to avoid a static sampler -> stream import cycle; they
+        # sample through the jitted overlay pipeline (TPU mode,
+        # positional relabel, uniform draws only)
+        is_stream = _is_stream_graph(csr_topo)
+        if is_stream:
+            if mode not in ("TPU",):
+                raise ValueError(
+                    f"StreamingGraph samples in TPU mode only, got "
+                    f"{mode!r} (compact to a frozen CSRTopo for "
+                    "CPU/UVA sampling)")
+            if dedup == "auto":
+                dedup = "none"
         if mode == "UVA" and uva_budget is None:
             mode = "TPU"  # whole graph fits the (unbounded) budget
         from .config import (resolve_dedup, resolve_gather_mode,
@@ -267,7 +357,12 @@ class GraphSageSampler:
         self.gather_mode = resolve_gather_mode(gather_mode, sample_rng)
         self.sample_rng = resolve_sample_rng(sample_rng, self.gather_mode)
         self.return_eid = return_eid
-        self.csr_topo = csr_topo
+        self.csr_topo = csr_topo  # property setter: splits stream/frozen
+        if is_stream:
+            assert dedup == "none", (
+                "StreamingGraph: positional pipeline only (dedup='none')")
+            assert edge_weights is None, (
+                "StreamingGraph: uniform sampling only")
         self.sizes = list(sizes)
         self.mode = mode
         self.dedup = dedup
@@ -307,7 +402,31 @@ class GraphSageSampler:
             self._cum_weights = pad_table_128(
                 _jnp.asarray(cw), fill=float(cw[-1]) if len(cw) else None)
         if mode == "TPU":
-            csr_topo.to_device(device)
+            if self._stream is not None:
+                self._stream.snapshot(device)  # warm the device view
+            else:
+                csr_topo.to_device(device)
+
+    # -- topology access ----------------------------------------------
+    @property
+    def csr_topo(self):
+        """The live base CSR.  For streaming graphs this follows the
+        compactor's base swaps; single-hop helpers (``sample_layer``,
+        ``sample_prob``) read it and therefore see the base WITHOUT the
+        pending delta overlay — multi-hop :meth:`sample` is the overlay-
+        aware path."""
+        if self._stream is not None:
+            return self._stream.base
+        return self._csr_topo
+
+    @csr_topo.setter
+    def csr_topo(self, value):
+        if _is_stream_graph(value):
+            self._stream = value
+            self._csr_topo = None
+        else:
+            self._stream = None
+            self._csr_topo = value
 
     # -- single-hop API (parity with sample_layer / reindex,
     #    sage_sampler.py:83-116) --------------------------------------
@@ -364,11 +483,44 @@ class GraphSageSampler:
 
         return fn
 
-    def sample(self, input_nodes, key=None) -> SampledBatch:
+    def _build_stream_jit(self, batch_size: int, windowed: bool):
+        """Compile the overlay pipeline for one (batch, snapshot-shape)
+        key.  Unlike :meth:`_build_jit` the topology arrays are traced
+        ARGUMENTS, not closure constants: snapshot contents change every
+        graph version, and baking them in would recompile per mutation.
+        Executables therefore key on shapes only —
+        ``(B, epad, delta_bucket, has_ts, windowed)`` — which is the
+        additive-key discipline the retrace budget enforces."""
+        sizes = tuple(self.sizes)
+        gm = self.gather_mode
+        srng = self.sample_rng
+        ret_eid = self.return_eid
+        caps = tuple(self.frontier_caps)
+
+        @jax.jit
+        def fn(indptr, indices, tomb, d_indptr, d_indices, base_ts, d_ts,
+               seeds, key, window_lo, window_hi):
+            overlay = dict(tomb=tomb, d_indptr=d_indptr,
+                           d_indices=d_indices, base_ts=base_ts,
+                           d_ts=d_ts, window_lo=window_lo,
+                           window_hi=window_hi, windowed=windowed)
+            return run_pipeline("none", indptr, indices, seeds, key,
+                                sizes, caps, gather_mode=gm,
+                                return_eid=ret_eid, sample_rng=srng,
+                                overlay=overlay)
+
+        return fn
+
+    def sample(self, input_nodes, key=None,
+               time_window=None) -> SampledBatch:
         """Sample k-hop neighborhood of ``input_nodes``.
 
         Returns a :class:`SampledBatch`; call ``.to_pyg_adjs()`` for the
         reference's ``(n_id, batch_size, adjs)`` tuple.
+
+        ``time_window=(lo, hi)`` (streaming graphs with ``edge_ts``
+        only) restricts draws to edges with ``lo <= ts < hi``; the
+        window rides as traced scalars, so varying it never recompiles.
 
         Telemetry: each call folds into the ``sampler.sample`` span and
         the ``sampler_sample_seconds{mode}`` histogram (TPU mode times
@@ -378,13 +530,21 @@ class GraphSageSampler:
         mode = self.mode.lower()
         with telemetry.span("sampler.sample"), telemetry.histogram(
                 "sampler_sample_seconds", mode=mode).time():
-            batch = self._sample_impl(input_nodes, key)
+            batch = self._sample_impl(input_nodes, key,
+                                      time_window=time_window)
         telemetry.counter("sampler_batches_total", mode=mode).inc()
         telemetry.counter("sampler_seeds_total", mode=mode).inc(
             float(batch.batch_size))
         return batch
 
-    def _sample_impl(self, input_nodes, key=None) -> SampledBatch:
+    def _sample_impl(self, input_nodes, key=None,
+                     time_window=None) -> SampledBatch:
+        if self._stream is not None:
+            return self._sample_stream(input_nodes, key, time_window)
+        if time_window is not None:
+            raise ValueError(
+                "time_window requires a StreamingGraph with per-edge "
+                "timestamps (quiver_tpu.stream)")
         if self.mode == "CPU":
             return self._sample_cpu(input_nodes)
         if self.mode == "UVA":
@@ -411,6 +571,49 @@ class GraphSageSampler:
         return SampledBatch(
             n_id=n_id, n_id_mask=n_mask, num_nodes=num_nodes,
             batch_size=B, layers=blocks, drops=drops,
+        )
+
+    def _sample_stream(self, input_nodes, key, time_window) -> SampledBatch:
+        """Overlay-aware multi-hop sampling against the current
+        :class:`~quiver_tpu.stream.graph.DeltaSnapshot`."""
+        snap = self._stream.snapshot(self.device)
+        windowed = time_window is not None
+        if windowed and not snap.has_ts:
+            raise ValueError(
+                "time_window needs a StreamingGraph constructed with "
+                "edge_ts")
+        if isinstance(input_nodes, jax.Array):  # stay on device
+            seeds = input_nodes.astype(jnp.int32)
+        else:
+            seeds = jnp.asarray(np.asarray(input_nodes), dtype=jnp.int32)
+        B = seeds.shape[0]
+        jk = ("stream", B, snap.epad, snap.delta_bucket, snap.has_ts,
+              windowed)
+        fn = self._jitted.get(jk)
+        if fn is None:
+            fn = self._jitted[jk] = self._build_stream_jit(B, windowed)
+        if key is None:
+            from .utils.rng import make_key
+
+            key = make_key(np.random.randint(0, 2**31 - 1))
+        if windowed:
+            lo, hi = time_window
+            # device scalars, not Python ints: traced operands, so a new
+            # window is a new argument value — never a new executable
+            window_lo = jnp.int32(lo)
+            window_hi = jnp.int32(hi)
+        else:
+            window_lo = window_hi = None
+        n_id, n_mask, num_nodes, blocks, drops = fn(
+            snap.indptr, snap.indices, snap.tomb, snap.d_indptr,
+            snap.d_indices, snap.base_ts, snap.d_ts, seeds, key,
+            window_lo, window_hi)
+        self.last_drops = drops
+        self._drops_recorded = False
+        return SampledBatch(
+            n_id=n_id, n_id_mask=n_mask, num_nodes=num_nodes,
+            batch_size=B, layers=blocks, drops=drops,
+            version=snap.version,
         )
 
     def overflow_stats(self, batch: Optional[SampledBatch] = None):

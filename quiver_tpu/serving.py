@@ -75,10 +75,17 @@ class ServingRequest:
     # admission-control ordering: under overload the BoundedLanes shed
     # strictly-lower-priority requests first
     priority: int = 0
+    # graph version at admission (streaming deployments; None without a
+    # StreamingGraph).  The consistency contract is stated against it:
+    # the batch serving this request samples a snapshot with
+    # version >= graph_version (snapshots only move forward)
+    graph_version: Optional[int] = None
 
     def __post_init__(self):
         if self.deadline is None:
             self.deadline = deadline_for(self.t_enqueue)
+        if self.graph_version is None:
+            self.graph_version = flightrec.graph_version()
         if self.trace is None:
             self.trace = flightrec.new_trace()
             if self.trace is not None:

@@ -1,0 +1,31 @@
+"""quiver_tpu.stream — graph mutation as a first-class workload.
+
+The streaming tier layers a **delta-CSR overlay** (append-only edge
+segment + tombstone bitmap, ``stream.delta`` / ``stream.graph``) over
+the frozen base CSR, samples through it inside the jitted pipeline
+(``ops.sample.sample_neighbors_overlay``, optional temporal windows),
+folds it back into a fresh base on cadence (``stream.compactor``), and
+admits edge updates through a bounded serving lane with its own
+deadline class (``stream.ingest``).  See docs/STREAMING.md for the
+overlay model, the consistency guarantees, and the config knobs.
+
+Quick start::
+
+    from quiver_tpu.stream import StreamingGraph, IngestLane, Compactor
+    g = StreamingGraph(csr_topo, edge_ts=ts)       # ts optional
+    sampler = GraphSageSampler(g, sizes=[10, 5])   # overlay-aware
+    g.attach_feature(feature)                      # row invalidation
+    lane = IngestLane(g).start()                   # serving ingestion
+    lane.submit(src, dst, ts=now)                  # ack on lane.results
+    batch = sampler.sample(seeds, key, time_window=(t0, t1))
+"""
+
+from .compactor import Compactor, compact
+from .delta import DeltaStore
+from .graph import DeltaSnapshot, StreamingGraph
+from .ingest import EdgeUpdate, IngestLane
+
+__all__ = [
+    "StreamingGraph", "DeltaSnapshot", "DeltaStore",
+    "Compactor", "compact", "EdgeUpdate", "IngestLane",
+]

@@ -1,0 +1,144 @@
+"""Compaction — fold the delta overlay into a fresh base CSR.
+
+A fold concatenates the surviving base edges (tombstones dropped) with
+the live delta edges and rebuilds CSR via the same stable counting sort
+the initial graph build uses (``utils.topology.coo_to_csr``).  Stable
+order gives every row ``[surviving base neighbors in base order, delta
+neighbors in append order]`` — exactly the virtual concatenation the
+overlay sampler draws from, so a seed's post-compaction neighborhood is
+the overlay neighborhood with the dead entries squeezed out.  After the
+swap the overlay is empty: sampling drops back to the zero-delta path,
+which is bitwise-identical to a frozen-CSR sampler on the new base.
+
+The swap runs under the graph lock and is **atomic** from the samplers'
+point of view: in-flight snapshots keep their (immutable) device
+arrays; the next ``snapshot()`` call sees the new base.  The fold
+itself (numpy sort over E edges) also runs under the lock — mutations
+arriving mid-fold would otherwise be folded twice or lost.  The pause
+this imposes on ingestion is the quantity the bench's ``stream_ingest``
+section reports (``stream_compact_pause_seconds``).
+
+Chaos: ``stream.compact`` fires before any state is touched, so an
+injected fault aborts the fold with the graph unchanged — the
+:class:`Compactor` loop records it and retries next tick (the e2e chaos
+test drives this path).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+from .. import telemetry
+from ..resilience import chaos
+from ..utils.topology import CSRTopo, coo_to_csr
+
+__all__ = ["compact", "Compactor"]
+
+log = logging.getLogger("quiver_tpu.stream")
+
+_CHAOS_COMPACT = chaos.point("stream.compact")
+
+
+def compact(graph) -> dict:
+    """Fold ``graph``'s overlay into a fresh base CSR and swap it in.
+
+    Returns fold stats; raises whatever the ``stream.compact`` chaos
+    point injects (state untouched in that case).
+    """
+    t0 = time.perf_counter()
+    with graph._lock:
+        _CHAOS_COMPACT()
+        base = graph._base
+        n = base.node_count
+        keep = ~graph._tomb
+        dropped = int(graph._tomb.sum())
+        d_src, d_dst, d_ts = graph._delta.live_edges()
+        folded = len(d_src)
+        # base edges back to COO rows, tombstones squeezed out
+        bsrc = np.repeat(
+            np.arange(n, dtype=np.int64), base.degree)[keep]
+        src = np.concatenate([bsrc, d_src.astype(np.int64)])
+        dst = np.concatenate(
+            [base.indices[keep].astype(np.int64),
+             d_dst.astype(np.int64)])
+        indptr, indices, eid = coo_to_csr(src, dst, n)
+        new_base = CSRTopo(indptr=indptr, indices=indices)
+        new_base.feature_order = base.feature_order
+        if graph.has_ts:
+            ts = np.concatenate([graph._base_ts[keep], d_ts])
+            graph._base_ts = ts[eid].astype(np.int32)
+        # the swap: old base stays valid for in-flight snapshots (its
+        # arrays are immutable); dropping our reference is the whole
+        # invalidation — plus the explicit version bump + device-cache
+        # invalidate so NOTHING can serve the old topology as current
+        base.invalidate()
+        graph._base = new_base
+        graph._tomb = np.zeros(new_base.edge_count, dtype=bool)
+        graph._tombstones = 0
+        graph._delta.clear()
+        graph._version += 1
+        graph._snap = None
+        version = graph._version
+    pause = time.perf_counter() - t0
+    telemetry.counter("stream_compactions_total").inc()
+    telemetry.histogram("stream_compact_pause_seconds").observe(pause)
+    telemetry.gauge("stream_overlay_bytes").set(0.0)
+    telemetry.gauge("stream_graph_version_total").set(version)
+    return dict(folded=folded, dropped=dropped, pause_s=pause,
+                version=version, edges=new_base.edge_count)
+
+
+class Compactor(threading.Thread):
+    """Background thread folding the overlay on cadence or watermark.
+
+    A fold triggers when either ``interval_s`` has elapsed since the
+    last one **and** there is anything pending, or the pending fraction
+    of delta capacity crosses ``watermark`` (checked every poll tick).
+    """
+
+    def __init__(self, graph, interval_s: Optional[float] = None,
+                 watermark: Optional[float] = None,
+                 poll_s: float = 0.05):
+        from ..config import get_config
+
+        cfg = get_config()
+        super().__init__(daemon=True, name="quiver-stream-compactor")
+        self.graph = graph
+        self.interval_s = float(interval_s if interval_s is not None
+                                else cfg.stream_compact_interval_s)
+        self.watermark = float(watermark if watermark is not None
+                               else cfg.stream_compact_watermark)
+        self.poll_s = float(poll_s)
+        self._stop_ev = threading.Event()
+        self._last = time.perf_counter()
+
+    def _due(self) -> bool:
+        pending = self.graph.pending_deltas
+        tombs = self.graph.tombstone_count
+        if pending + tombs == 0:
+            return False
+        if pending >= self.watermark * self.graph._delta.capacity:
+            return True
+        return time.perf_counter() - self._last >= self.interval_s
+
+    def run(self):
+        while not self._stop_ev.wait(self.poll_s):
+            try:
+                if self._due():
+                    compact(self.graph)
+                    self._last = time.perf_counter()
+            except Exception as e:
+                # a failed fold (chaos, transient OOM) leaves the graph
+                # unchanged; record it and retry next tick — silently
+                # swallowing would let the overlay grow to capacity
+                telemetry.counter("stream_compact_errors_total").inc()
+                log.warning("compaction failed (will retry): %s", e)
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._stop_ev.set()
+        self.join(timeout=timeout)

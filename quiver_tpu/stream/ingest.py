@@ -1,0 +1,178 @@
+"""Serving ingestion lane for edge updates.
+
+Edge mutations enter serving deployments through their own
+:class:`~quiver_tpu.resilience.lanes.BoundedLane` — NOT the query lanes
+— with their own deadline class (``config.stream_ingest_deadline_ms``)
+and shed priority (``config.stream_ingest_priority``).  Keeping the
+lane separate means a mutation burst sheds mutations, never queries,
+and vice versa; the priority knob decides who wins when an operator
+routes both through one consumer.
+
+Every update is stamped at admission with ``t_enqueue``, an absolute
+deadline, a flight-recorder trace (which itself carries the graph
+version current at admission), and ``admitted_version`` — the
+consistency handle: once the worker acks an update at version ``v``,
+every sample taken from a snapshot with ``version >= v`` reflects it
+(the e2e test in ``tests/test_stream.py`` enforces exactly this).
+
+Results travel as ``(update, outcome)`` tuples on ``results``:
+``outcome`` is ``("ok", applied_count, version)`` on success, or the
+exception instance (``LoadShed`` / ``DeadlineExceeded`` from the shed
+path, the raised error otherwise).
+
+Chaos: ``stream.ingest`` fires inside the worker before the graph is
+touched, so injected faults produce clean ``(update, exc)`` answers.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+from .. import telemetry
+from ..resilience import chaos
+from ..resilience.deadline import deadline_for, shed_if_expired
+from ..resilience.lanes import BoundedLane
+from ..telemetry import flightrec
+from .compactor import compact
+
+__all__ = ["EdgeUpdate", "IngestLane"]
+
+_CHAOS_INGEST = chaos.point("stream.ingest")
+
+_STOP = object()
+
+
+@dataclass
+class EdgeUpdate:
+    """One edge mutation request (shed-compatible: carries the same
+    admission fields as a ServingRequest)."""
+
+    src: object
+    dst: object
+    ts: Optional[object] = None
+    op: str = "add"                 # "add" | "remove"
+    t_enqueue: float = 0.0          # perf_counter at admission
+    deadline: Optional[float] = None
+    priority: int = 0
+    trace: object = None
+    admitted_version: int = -1      # graph version at admission
+    meta: dict = field(default_factory=dict)
+
+
+class IngestLane:
+    """Bounded edge-update lane + single writer thread.
+
+    One writer serializes graph mutations (the ``StreamingGraph`` lock
+    makes concurrent writers safe, but a single writer keeps version
+    order equal to ack order, which is what the consistency contract is
+    stated in).  ``BufferError`` from a full delta segment triggers an
+    inline compaction and a retry — backpressure folds, it never drops.
+    """
+
+    def __init__(self, graph, depth: Optional[int] = None,
+                 deadline_ms: Optional[float] = None,
+                 priority: Optional[int] = None,
+                 result_queue=None, compact_on_full: bool = True):
+        from ..config import get_config
+
+        cfg = get_config()
+        self.graph = graph
+        self.deadline_ms = float(
+            deadline_ms if deadline_ms is not None
+            else cfg.stream_ingest_deadline_ms)
+        self.priority = int(priority if priority is not None
+                            else cfg.stream_ingest_priority)
+        self.results = (result_queue if result_queue is not None
+                        else queue.Queue())
+        self.lane = BoundedLane(
+            "stream_ingest",
+            maxsize=int(depth if depth is not None
+                        else cfg.stream_ingest_depth),
+            result_queue=self.results)
+        self.compact_on_full = compact_on_full
+        self._thread = threading.Thread(
+            target=self._ingest_worker, daemon=True,
+            name="quiver-stream-ingest")
+
+    # -- producer side -------------------------------------------------
+    def start(self) -> "IngestLane":
+        self._thread.start()
+        return self
+
+    def submit(self, src, dst, ts=None, op: str = "add",
+               priority: Optional[int] = None) -> EdgeUpdate:
+        """Enqueue one edge update; returns the stamped request (its
+        answer arrives on ``results``).  May shed a lower-priority
+        queued update (or this one) under load — the shed victim is
+        answered with ``LoadShed`` on ``results``."""
+        now = time.perf_counter()
+        upd = EdgeUpdate(
+            src=src, dst=dst, ts=ts, op=op, t_enqueue=now,
+            deadline=deadline_for(now, self.deadline_ms),
+            priority=self.priority if priority is None else int(priority),
+            trace=flightrec.new_trace(),
+            admitted_version=self.graph.version,
+        )
+        if upd.trace is not None:
+            upd.trace.add("stream.enqueue",
+                          {"op": op, "lane": "stream_ingest"})
+        self.lane.put(upd)
+        return upd
+
+    # -- consumer side -------------------------------------------------
+    def _apply(self, upd: EdgeUpdate) -> int:
+        if upd.op == "add":
+            try:
+                return self.graph.add_edges(upd.src, upd.dst, upd.ts)
+            except BufferError:
+                if not self.compact_on_full:
+                    raise
+                compact(self.graph)  # backpressure: fold, then retry
+                return self.graph.add_edges(upd.src, upd.dst, upd.ts)
+        if upd.op == "remove":
+            return self.graph.remove_edges(upd.src, upd.dst)
+        raise ValueError(f"unknown edge op {upd.op!r}")
+
+    def _ingest_worker(self):
+        while True:
+            item = self.lane.get()
+            if item is _STOP:
+                return
+            try:
+                if shed_if_expired(item, self.results, "stream_ingest"):
+                    continue
+                with flightrec.activate(item.trace):
+                    _CHAOS_INGEST()
+                    applied = self._apply(item)
+                version = self.graph.version
+                if item.trace is not None:
+                    item.trace.add("stream.applied",
+                                   {"n": applied, "version": version})
+                    flightrec.get_recorder().finish(
+                        item.trace,
+                        time.perf_counter() - item.t_enqueue,
+                        status="ok", lane="stream_ingest")
+                self.results.put((item, ("ok", applied, version)))
+            except Exception as e:
+                # answer the producer with the exception object (chaos
+                # faults, bad ops) — an unanswered update would hang a
+                # waiting producer forever
+                telemetry.counter("stream_ingest_errors_total").inc()
+                if item.trace is not None:
+                    flightrec.get_recorder().finish(
+                        item.trace,
+                        time.perf_counter() - item.t_enqueue,
+                        status="error", lane="stream_ingest")
+                self.results.put((item, e))
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self.lane.put(_STOP)
+        self._thread.join(timeout=timeout)
+
+    @property
+    def depth(self) -> int:
+        return self.lane.qsize()

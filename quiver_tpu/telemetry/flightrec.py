@@ -49,7 +49,31 @@ __all__ = [
     "TraceContext", "FlightRecorder",
     "new_trace", "current", "active", "activate", "event", "flag",
     "tracing", "get_recorder", "reset",
+    "set_version_provider", "graph_version",
 ]
+
+# -- graph-version stamping (quiver_tpu.stream) -------------------------
+# The streaming tier registers its version counter here; every trace
+# created afterwards carries the graph version that was current at its
+# admission, so a retained flight record pins exactly which topology a
+# slow/errored request sampled against.  None until a StreamingGraph
+# registers (frozen-CSR deployments pay one global read per trace).
+_VERSION_PROVIDER = None
+
+
+def set_version_provider(fn) -> None:
+    """Register a zero-arg callable returning the current graph version
+    (``None`` unregisters).  Called by ``stream.StreamingGraph``."""
+    global _VERSION_PROVIDER
+    _VERSION_PROVIDER = fn
+
+
+def graph_version() -> Optional[int]:
+    """Current graph version, or None when no streaming graph is live."""
+    fn = _VERSION_PROVIDER
+    if fn is None:
+        return None
+    return int(fn())
 
 # events per trace are capped so one pathological request (a chunked
 # giant batch, a retry loop) cannot grow without bound while in flight
@@ -85,7 +109,7 @@ class TraceContext:
                    "flagged": "_lock"}
 
     __slots__ = ("trace_id", "t_start", "wall_start", "events", "dropped",
-                 "flagged", "_lock")
+                 "flagged", "graph_version", "_lock")
 
     def __init__(self, trace_id: Optional[str] = None):
         self.trace_id = trace_id or _next_trace_id()
@@ -94,6 +118,9 @@ class TraceContext:
         self.events: List[Tuple[float, str, str, Optional[dict]]] = []
         self.dropped = 0
         self.flagged = False
+        # topology version at admission (None without a streaming graph);
+        # immutable after construction, so unguarded reads are safe
+        self.graph_version = graph_version()
         self._lock = threading.Lock()
 
     def add(self, name: str, attrs: Optional[dict] = None) -> None:
@@ -131,6 +158,8 @@ class TraceContext:
             ],
             "events_dropped": dropped,
         }
+        if self.graph_version is not None:
+            rec["graph_version"] = self.graph_version
         if e2e_seconds is not None:
             rec["e2e_seconds"] = float(e2e_seconds)
         if reason is not None:
@@ -362,10 +391,12 @@ def get_recorder() -> FlightRecorder:
 
 
 def reset() -> None:
-    """Drop retained records and re-read config (tests)."""
-    global _RECORDER
+    """Drop retained records, re-read config, unhook the graph-version
+    provider (tests)."""
+    global _RECORDER, _VERSION_PROVIDER
     with _recorder_lock:
         _RECORDER = None
+    _VERSION_PROVIDER = None
 
 
 def partition_check(record: dict, rel_tol: float = 0.25) -> bool:

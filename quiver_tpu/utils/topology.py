@@ -82,7 +82,11 @@ class CSRTopo:
         else:
             raise ValueError("need edge_index or (indptr, indices)")
         self.feature_order_: Optional[np.ndarray] = None
-        self._device_arrays = None
+        # device placements keyed by device (None = default device) — a
+        # dict, not a single slot, so to_device(devA) after to_device(devB)
+        # returns arrays on the device actually asked for
+        self._device_arrays: dict = {}
+        self._version = 0
 
     @property
     def indptr(self) -> np.ndarray:
@@ -126,12 +130,15 @@ class CSRTopo:
         ``[rows, 128]`` with a free in-jit reshape.  Padding is harmless to
         the XLA-take path (real entries come first; callers never index
         past ``node_count``/``edge_count``).  Requires
-        ``edge_count < 2**31``; larger graphs shard over the mesh.  Cached.
+        ``edge_count < 2**31``; larger graphs shard over the mesh.  Cached
+        per device; :meth:`invalidate` drops every cached placement.
         """
         import jax
         import jax.numpy as jnp
 
-        if self._device_arrays is None:
+        cache_key = device
+        cached = self._device_arrays.get(cache_key)
+        if cached is None:
             if self.edge_count >= 2**31:
                 raise ValueError(
                     "edge_count >= 2^31: shard the graph (quiver_tpu.dist) "
@@ -153,8 +160,25 @@ class CSRTopo:
             if device is not None:
                 indptr = jax.device_put(indptr, device)
                 indices = jax.device_put(indices, device)
-            self._device_arrays = (indptr, indices)
-        return self._device_arrays
+            cached = (indptr, indices)
+            self._device_arrays[cache_key] = cached
+        return cached
+
+    @property
+    def version(self) -> int:
+        """Bumped by :meth:`invalidate`; lets holders of device arrays
+        detect that their copy predates a topology swap."""
+        return self._version
+
+    def invalidate(self):
+        """Drop all cached device placements and bump :attr:`version`.
+
+        Must be called after mutating ``indptr_``/``indices_`` in place (the
+        stream compactor swaps whole arrays instead, but either way a stale
+        ``to_device`` result would silently serve the old topology).
+        """
+        self._device_arrays = {}
+        self._version += 1
 
     def share_memory_(self):  # torch-API compat: numpy arrays already share
         return self

@@ -296,3 +296,55 @@ def test_dist_overlay_equivalence(rng):
         assert np.array_equal(got[0], feats[ids[0]]), step
     st = df.cold_cache.stats()
     assert st["hits"] > 0 and st["evictions"] > 0
+
+
+# ------------------------------------------------- invalidation (stream)
+def test_invalidate_rows_miss_then_readmit():
+    c = ColdRowCache(capacity=8, n_rows=100, admit_threshold=2)
+    ids = np.array([3, 7], dtype=np.int64)
+    for _ in range(2):
+        hit, _ = c.probe(ids)
+        c.admit(ids[~hit])
+    assert c.probe(ids)[0].all()
+    assert c.invalidate_rows(np.array([3])) == 1
+    hit, _ = c.probe(ids)                   # this is touch 1 post-reset
+    assert not hit[0] and hit[1]            # only the mutated row dropped
+    # admission evidence was reset: one touch isn't enough...
+    slots, _ = c.admit(np.array([3]))
+    assert (slots == -1).all()
+    # ...second touch re-admits, into a serviceable slot
+    c.probe(np.array([3]))
+    slots, _ = c.admit(np.array([3]))
+    assert (slots >= 0).all()
+    assert c.probe(np.array([3]))[0].all()
+
+
+def test_invalidate_rows_ignores_nonresident_and_out_of_range():
+    c = ColdRowCache(capacity=4, n_rows=10, admit_threshold=1)
+    assert c.invalidate_rows(np.array([-5, 3, 42])) == 0
+    assert c.invalidate_rows(np.array([], dtype=np.int64)) == 0
+
+
+def test_dist_overlay_invalidate_rows(rng):
+    from jax.sharding import Mesh
+    from quiver_tpu.dist.feature import PartitionInfo, DistFeature
+
+    N, D, H = 400, 6, 4
+    feats = rng.normal(size=(N, D)).astype(np.float32)
+    g2h = rng.integers(0, H, size=N)
+    rep = rng.choice(N, size=10, replace=False)
+    info = PartitionInfo(host=1, hosts=H, global2host=g2h, replicate=rep)
+    mesh = Mesh(np.array(jax.devices()[:H]), ("data",))
+    df = DistFeature.from_global_feature(feats, mesh, info)
+    df.enable_cold_cache(rows=64, admit_threshold=1)
+    # a remote, non-replicated row: the overlay's bread and butter
+    remote = int(np.where((g2h != 1)
+                          & ~np.isin(np.arange(N), rep))[0][0])
+    ids = np.full((H, 8), remote, dtype=np.int32)
+    for _ in range(2):
+        df.lookup(ids)
+    assert df.cold_cache.probe(np.array([remote]))[0].all()
+    assert df.invalidate_rows([remote]) == 1
+    assert not df.cold_cache.probe(np.array([remote]))[0].any()
+    got = np.asarray(df.lookup(ids))        # correct rows served post-drop
+    assert np.array_equal(got[1], feats[ids[1]])

@@ -174,6 +174,42 @@ class TestRetrace:
         assert codes(r) == ["QT002"]
         assert "self" in r.findings[0].message
 
+    def test_flags_jit_in_compactor_loop(self, tmp_path):
+        # the stream-compactor shape: a background fold loop that mints
+        # a fresh executable per compaction instead of keying a cache
+        r = run_lint(tmp_path, """
+            import jax
+
+            class Compactor:
+                def run(self, graph):
+                    while not self._stop.is_set():
+                        fold = jax.jit(lambda i: graph.merge(i))
+                        fold(graph.snapshot())
+        """, name="quiver_tpu/stream/compactor.py")
+        assert "QT002" in codes(r)
+
+    def test_snapshot_keyed_stream_cache_is_clean(self, tmp_path):
+        # the shipped sampler idiom: executables cached on snapshot
+        # SHAPE keys, content arrives as traced operands
+        r = run_lint(tmp_path, """
+            import jax
+
+            class S:
+                def _build_stream_jit(self, batch_size, windowed):
+                    def fn(indptr, indices, seeds, key):
+                        return seeds
+                    return jax.jit(fn)
+
+                def sample(self, snap, seeds, key):
+                    jk = ("stream", len(seeds), snap.epad)
+                    fn = self._jitted.get(jk)
+                    if fn is None:
+                        fn = self._jitted[jk] = self._build_stream_jit(
+                            len(seeds), False)
+                    return fn(snap.indptr, snap.indices, seeds, key)
+        """, name="quiver_tpu/stream/sampler.py")
+        assert r.findings == []
+
 
 # ------------------------------------------------------------ QT003
 class TestLockDiscipline:
@@ -438,6 +474,41 @@ class TestSilentExcept:
                     except BaseException as e:
                         results.put((e, "error"))
         """, hot_modules=ALL_HOT)
+        assert r.findings == []
+
+    def test_flags_silent_compactor_loop(self, tmp_path):
+        # a fold failure swallowed here would stall compaction forever
+        # with no ledger entry — exactly what QT007 exists to reject
+        r = run_lint(tmp_path, """
+            class Compactor:
+                def run(self):
+                    while not self._stop.wait(self.poll_s):
+                        try:
+                            self._maybe_compact()
+                        except Exception:
+                            continue
+        """, name="quiver_tpu/stream/compactor.py")
+        assert codes(r) == ["QT007"]
+
+    def test_compactor_recording_failures_is_clean(self, tmp_path):
+        # the shipped idiom: tick the error counter and log, keep going
+        r = run_lint(tmp_path, """
+            import logging
+
+            from quiver_tpu import telemetry
+
+            log = logging.getLogger(__name__)
+
+            class Compactor:
+                def run(self):
+                    while not self._stop.wait(self.poll_s):
+                        try:
+                            self._maybe_compact()
+                        except Exception as e:
+                            telemetry.counter(
+                                "stream_compact_errors_total").inc()
+                            log.warning("compaction failed: %s", e)
+        """, name="quiver_tpu/stream/compactor.py")
         assert r.findings == []
 
     def test_reraise_is_clean(self, tmp_path):

@@ -1,0 +1,496 @@
+"""quiver_tpu.stream suite (docs/STREAMING.md).
+
+Correctness bar, in order of importance:
+
+* **Equivalence** — a StreamingGraph with zero pending deltas must
+  sample BIT-IDENTICAL to the frozen-CSR path on the same key, and a
+  post-compaction graph must sample bit-identical to a fresh frozen
+  sampler built on the folded CSR.  The overlay is an implementation
+  detail; it must never show through in the sample distribution.
+* **Deletion** — a tombstoned edge never appears in any sample, before
+  or after compaction.
+* **Time windows** — ``time_window=(lo, hi)`` excludes edges outside
+  ``lo <= ts < hi``, and changing the window re-uses the executable.
+* **Steady-state ingestion** holds the retrace budget: mutations within
+  one delta bucket never mint a new executable.
+* **E2E** — concurrent ingest + sampling with a mid-stream compaction
+  under a ``stream.compact`` chaos fault: every submitted update is
+  answered, sampled versions are monotone and catch up to acked
+  admission versions, and deleted edges stay gone throughout.
+"""
+
+import queue
+import threading
+import time
+
+import numpy as np
+import jax
+import pytest
+
+import quiver_tpu.config as config_mod
+from quiver_tpu import Feature, GraphSageSampler, telemetry
+from quiver_tpu.resilience import chaos
+from quiver_tpu.stream import (
+    Compactor, DeltaStore, EdgeUpdate, IngestLane, StreamingGraph, compact,
+)
+from quiver_tpu.telemetry import flightrec, metric_key
+from quiver_tpu.utils.rng import make_key
+from quiver_tpu.utils.topology import CSRTopo
+
+pytestmark = pytest.mark.stream
+
+
+@pytest.fixture(autouse=True)
+def _clean_stream():
+    telemetry.set_enabled(True)
+    telemetry.reset()
+    flightrec.reset()
+    yield
+    chaos.uninstall()
+    flightrec.reset()
+    telemetry.set_enabled(True)
+    telemetry.reset()
+
+
+def counter_value(name, **labels):
+    return telemetry.snapshot()["counters"].get(metric_key(name, labels), 0)
+
+
+def _random_edges(rng, n=500, e=2000):
+    return np.stack([rng.integers(0, n, size=e),
+                     rng.integers(0, n, size=e)])
+
+
+def _star_topo(n_nodes=200, fanout=9):
+    """node 0 -> 1..fanout, plus a self-loop pinning node_count."""
+    src = np.append(np.zeros(fanout, np.int64), n_nodes - 1)
+    dst = np.append(np.arange(1, fanout + 1), n_nodes - 1)
+    return CSRTopo(edge_index=np.stack([src, dst]))
+
+
+def _sampled_neighbors(batch):
+    """Set of neighbor node ids drawn for the (single-seed) batch."""
+    mask = np.asarray(batch.layers[0].mask)[0]
+    return set(int(x) for x in np.asarray(batch.n_id)[1:][mask])
+
+
+def _assert_batches_equal(a, b):
+    np.testing.assert_array_equal(np.asarray(a.n_id), np.asarray(b.n_id))
+    np.testing.assert_array_equal(np.asarray(a.n_id_mask),
+                                  np.asarray(b.n_id_mask))
+    assert len(a.layers) == len(b.layers)
+    for la, lb in zip(a.layers, b.layers):
+        np.testing.assert_array_equal(np.asarray(la.nbr_local),
+                                      np.asarray(lb.nbr_local))
+        np.testing.assert_array_equal(np.asarray(la.mask),
+                                      np.asarray(lb.mask))
+
+
+# ================================================== DeltaStore (unit)
+class TestDeltaStore:
+    def test_append_order_and_live_edges(self):
+        d = DeltaStore(capacity=8)
+        d.add([1, 2], [3, 4])
+        d.add(5, 6)
+        src, dst, ts = d.live_edges()
+        np.testing.assert_array_equal(src, [1, 2, 5])
+        np.testing.assert_array_equal(dst, [3, 4, 6])
+        assert ts is None and d.live == 3
+
+    def test_kill_marks_last_live_match(self):
+        d = DeltaStore(capacity=8)
+        d.add([1, 1, 1], [2, 2, 3])
+        assert d.kill(1, 2)
+        src, dst, _ = d.live_edges()
+        np.testing.assert_array_equal(src, [1, 1])
+        np.testing.assert_array_equal(dst, [2, 3])
+        assert not d.kill(9, 9)            # no match: report, don't raise
+
+    def test_capacity_backpressure(self):
+        d = DeltaStore(capacity=2)
+        d.add([1, 2], [3, 4])
+        with pytest.raises(BufferError):
+            d.add(5, 6)
+        d.clear()
+        d.add(5, 6)                        # clear() frees the buffer
+        assert d.live == 1
+
+    def test_timestamps_required_when_declared(self):
+        d = DeltaStore(capacity=4, has_ts=True)
+        with pytest.raises(ValueError):
+            d.add(1, 2)
+        d.add(1, 2, ts=7)
+        _, _, ts = d.live_edges()
+        np.testing.assert_array_equal(ts, [7])
+
+
+# ============================================= equivalence (tentpole)
+def test_zero_delta_bitwise_equivalence():
+    rng = np.random.default_rng(0)
+    ei = _random_edges(rng)
+    topo = CSRTopo(edge_index=ei)
+    g = StreamingGraph(CSRTopo(edge_index=ei))
+    try:
+        stream = GraphSageSampler(g, sizes=[5, 3], gather_mode="xla",
+                                  sample_rng="hash")
+        frozen = GraphSageSampler(topo, sizes=[5, 3], dedup="none",
+                                  gather_mode="xla", sample_rng="hash")
+        seeds = rng.integers(0, topo.node_count, size=16)
+        for s in range(3):
+            bs = stream.sample(seeds, key=make_key(s))
+            bf = frozen.sample(seeds, key=make_key(s))
+            _assert_batches_equal(bs, bf)
+            assert bs.version == 0
+    finally:
+        g.close()
+
+
+def test_post_compaction_bitwise_equivalence():
+    rng = np.random.default_rng(1)
+    g = StreamingGraph(CSRTopo(edge_index=_random_edges(rng)))
+    try:
+        sampler = GraphSageSampler(g, sizes=[5, 3], gather_mode="xla",
+                                   sample_rng="hash")
+        n = g.node_count
+        g.add_edges(rng.integers(0, n, 40), rng.integers(0, n, 40))
+        # tombstone one real base edge
+        u = int(np.argmax(g.base.degree))
+        v = int(g.base.indices[g.base.indptr[u]])
+        g.remove_edges([u], [v])
+        stats = compact(g)
+        assert stats["dropped"] == 1 and stats["folded"] == 40
+        assert g.pending_deltas == 0 and g.tombstone_count == 0
+        fresh = GraphSageSampler(g.base, sizes=[5, 3], dedup="none",
+                                 gather_mode="xla", sample_rng="hash")
+        seeds = rng.integers(0, n, size=16)
+        for s in range(3):
+            _assert_batches_equal(sampler.sample(seeds, key=make_key(s)),
+                                  fresh.sample(seeds, key=make_key(s)))
+    finally:
+        g.close()
+
+
+def test_tombstoned_edge_never_sampled():
+    g = StreamingGraph(_star_topo())
+    try:
+        s = GraphSageSampler(g, sizes=[4], gather_mode="xla",
+                             sample_rng="hash")
+        g.remove_edges([0], [5])
+        seen = set()
+        for i in range(50):
+            seen |= _sampled_neighbors(s.sample([0], key=make_key(i)))
+        assert 5 not in seen
+        assert seen <= set(range(1, 10))
+        compact(g)
+        for i in range(50):
+            seen |= _sampled_neighbors(s.sample([0], key=make_key(i)))
+        assert 5 not in seen
+    finally:
+        g.close()
+
+
+def test_delta_edges_join_the_frontier():
+    g = StreamingGraph(_star_topo())
+    try:
+        s = GraphSageSampler(g, sizes=[4], gather_mode="xla",
+                             sample_rng="hash")
+        g.add_edges([0, 0], [100, 101])
+        seen = set()
+        for i in range(80):
+            seen |= _sampled_neighbors(s.sample([0], key=make_key(i)))
+        assert {100, 101} <= seen
+    finally:
+        g.close()
+
+
+def test_rejects_node_additions_and_frozen_mutation():
+    g = StreamingGraph(_star_topo(n_nodes=10))
+    try:
+        with pytest.raises(ValueError):
+            g.add_edges([0], [10])          # node 10 doesn't exist
+        with pytest.raises(ValueError):
+            g.add_edges([-1], [0])
+    finally:
+        g.close()
+    frozen = GraphSageSampler(_star_topo(), sizes=[4], dedup="none")
+    with pytest.raises(ValueError):
+        frozen.sample([0], key=make_key(0), time_window=(0, 5))
+
+
+# ================================================== temporal sampling
+def test_time_window_filters_edges():
+    topo = _star_topo()
+    ts = np.zeros(topo.edge_count, np.int64)
+    # star edges are contiguous in CSR row 0; stamp ts = dst id
+    row = topo.indices[topo.indptr[0]:topo.indptr[1]]
+    ts[topo.indptr[0]:topo.indptr[1]] = row
+    g = StreamingGraph(topo, edge_ts=ts)
+    try:
+        s = GraphSageSampler(g, sizes=[9], gather_mode="xla",
+                             sample_rng="hash")
+        b = s.sample([0], key=make_key(1), time_window=(3, 7))
+        assert _sampled_neighbors(b) <= {3, 4, 5, 6}
+        # widen the window: same executable, full frontier reachable
+        seen = set()
+        for i in range(40):
+            seen |= _sampled_neighbors(
+                s.sample([0], key=make_key(i), time_window=(1, 10)))
+        assert seen == set(range(1, 10))
+        assert len(s._jitted) == 1          # windows are traced operands
+    finally:
+        g.close()
+
+
+def test_time_window_applies_to_delta_edges():
+    topo = _star_topo()
+    g = StreamingGraph(topo, edge_ts=np.full(topo.edge_count, 5,
+                                             np.int64))
+    try:
+        s = GraphSageSampler(g, sizes=[9], gather_mode="xla",
+                             sample_rng="hash")
+        g.add_edges([0, 0], [50, 60], ts=[2, 8])
+        seen = set()
+        for i in range(60):
+            seen |= _sampled_neighbors(
+                s.sample([0], key=make_key(i), time_window=(4, 9)))
+        assert 60 in seen and 50 not in seen and seen >= {1, 2, 3}
+    finally:
+        g.close()
+
+
+def test_windowed_requires_timestamps():
+    g = StreamingGraph(_star_topo())
+    try:
+        s = GraphSageSampler(g, sizes=[4])
+        with pytest.raises(ValueError):
+            s.sample([0], key=make_key(0), time_window=(0, 5))
+    finally:
+        g.close()
+
+
+# ============================================== invalidation plumbing
+def test_mutations_invalidate_attached_feature_rows():
+    rng = np.random.default_rng(3)
+    topo = _star_topo(n_nodes=64)
+    feats = rng.standard_normal((64, 8)).astype(np.float32)
+    f = Feature(device_cache_size=16, cache_unit="rows").from_cpu_tensor(
+        feats)
+    f.enable_cold_cache(rows=16, admit_threshold=2)
+    g = StreamingGraph(topo)
+    try:
+        g.attach_feature(f)
+        cold = 40                           # beyond the 16-row hot prefix
+        for _ in range(2):                  # second touch admits
+            f[np.array([cold])].block_until_ready()
+        assert f.cold_cache.probe(np.array([cold - 16]))[0].all()
+        g.add_edges([cold], [1])            # mutation touches row `cold`
+        hit, _ = f.cold_cache.probe(np.array([cold - 16]))
+        assert not hit.any()                # miss after invalidation
+        for _ in range(2):
+            f[np.array([cold])].block_until_ready()
+        assert f.cold_cache.probe(np.array([cold - 16]))[0].all()
+        assert counter_value("coldcache_invalidated_rows_total") >= 1
+    finally:
+        g.close()
+
+
+def test_graph_version_stamped_on_traces():
+    g = StreamingGraph(_star_topo())
+    try:
+        assert flightrec.graph_version() == 0
+        g.add_edges([0], [11])
+        t = flightrec.new_trace()
+        assert t.graph_version == 1
+        assert t.to_record()["graph_version"] == 1
+    finally:
+        g.close()
+    assert flightrec.graph_version() is None   # provider unregistered
+
+
+# =================================================== retrace budgets
+@pytest.mark.retrace_budget(1)
+def test_steady_state_ingestion_holds_retrace_budget():
+    g = StreamingGraph(_star_topo(), delta_capacity=256)
+    try:
+        s = GraphSageSampler(g, sizes=[4], gather_mode="xla",
+                             sample_rng="hash")
+        seeds = np.zeros(8, np.int64)
+        s.sample(seeds, key=make_key(0))    # the one budgeted build
+        for i in range(20):                 # stays inside one delta bucket
+            g.add_edges([0], [20 + i])
+            s.sample(seeds, key=make_key(i))
+    finally:
+        g.close()
+
+
+# ============================================ ingestion lane + chaos
+def test_ingest_lane_applies_and_acks():
+    g = StreamingGraph(_star_topo(), delta_capacity=64)
+    lane = IngestLane(g, depth=32).start()
+    try:
+        ups = [lane.submit(0, 10 + i) for i in range(8)]
+        acks = [lane.results.get(timeout=5) for _ in range(8)]
+        assert all(isinstance(o, tuple) and o[0] == "ok" for _, o in acks)
+        assert g.pending_deltas == 8
+        assert all(u.admitted_version >= 0 for u in ups)
+        assert counter_value("stream_edges_applied_total", op="add") == 8
+    finally:
+        lane.stop()
+        g.close()
+
+
+def test_ingest_backpressure_compacts_inline():
+    g = StreamingGraph(_star_topo(), delta_capacity=8)
+    lane = IngestLane(g, depth=64).start()
+    try:
+        for i in range(20):                 # 2.5x the delta capacity
+            lane.submit(0, 10 + i)
+        acks = [lane.results.get(timeout=10) for _ in range(20)]
+        assert all(isinstance(o, tuple) and o[0] == "ok" for _, o in acks)
+        assert counter_value("stream_compactions_total") >= 1
+    finally:
+        lane.stop()
+        g.close()
+
+
+def test_ingest_chaos_fault_is_answered_not_dropped():
+    g = StreamingGraph(_star_topo(), delta_capacity=64)
+    lane = IngestLane(g, depth=32).start()
+    plan = chaos.ChaosPlan(seed=7).fail("stream.ingest", times=1)
+    try:
+        with chaos.active(plan):
+            for i in range(4):
+                lane.submit(0, 10 + i)
+            acks = [lane.results.get(timeout=5) for _ in range(4)]
+        faults = [o for _, o in acks if isinstance(o, BaseException)]
+        oks = [o for _, o in acks if isinstance(o, tuple)]
+        assert len(faults) == 1 and len(oks) == 3
+        assert counter_value("stream_ingest_errors_total") == 1
+        assert g.pending_deltas == 3        # the faulted update not applied
+    finally:
+        lane.stop()
+        g.close()
+
+
+def test_compactor_retries_after_chaos_fault():
+    g = StreamingGraph(_star_topo(), delta_capacity=64)
+    g.add_edges([0, 0], [30, 31])
+    plan = chaos.ChaosPlan(seed=7).fail("stream.compact", times=1)
+    comp = Compactor(g, interval_s=0.05, watermark=1.0, poll_s=0.01)
+    try:
+        with chaos.active(plan):
+            comp.start()
+            deadline = time.time() + 10
+            while g.pending_deltas and time.time() < deadline:
+                time.sleep(0.02)
+        assert g.pending_deltas == 0        # second attempt folded
+        assert counter_value("stream_compact_errors_total") == 1
+        assert counter_value("stream_compactions_total") == 1
+    finally:
+        comp.stop()
+        g.close()
+
+
+# ================================================ acceptance e2e
+@pytest.mark.retrace_budget(2)
+def test_e2e_concurrent_ingest_sample_compact_under_chaos():
+    """Concurrent ingest + sampling with a mid-stream compaction whose
+    first attempt takes a scripted ``stream.compact`` fault: every
+    update is answered, sampled graph versions are monotone and reach
+    every acked admission version, and a deleted edge never reappears —
+    all inside a 2-build retrace budget."""
+    rng = np.random.default_rng(42)
+    g = StreamingGraph(CSRTopo(edge_index=_random_edges(rng, n=300,
+                                                        e=1800)),
+                       delta_capacity=128)
+    # tombstone one base edge up front; it must stay gone throughout
+    dead_u = int(np.argmax(g.base.degree))
+    dead_v = int(g.base.indices[g.base.indptr[dead_u]])
+    g.remove_edges([dead_u], [dead_v])
+
+    sampler = GraphSageSampler(g, sizes=[6], gather_mode="xla",
+                               sample_rng="hash")
+    lane = IngestLane(g, depth=64).start()
+    comp = Compactor(g, interval_s=0.15, watermark=0.5, poll_s=0.01)
+    plan = chaos.ChaosPlan(seed=11).fail("stream.compact", times=1)
+
+    n_updates = 60
+    versions, dead_seen, errors = [], [], []
+    stop_sampling = threading.Event()
+
+    def sample_loop():
+        i = 0
+        try:
+            while not stop_sampling.is_set():
+                b = sampler.sample(np.full(8, dead_u, np.int64),
+                                   key=make_key(i))
+                versions.append(b.version)
+                mask = np.asarray(b.layers[0].mask)
+                nbrs = np.asarray(b.n_id)[np.asarray(b.layers[0].nbr_local)]
+                if dead_v in set(nbrs[mask].tolist()):
+                    dead_seen.append(i)
+                i += 1
+        except BaseException as e:          # surface, don't hang the join
+            errors.append(e)
+
+    t = threading.Thread(target=sample_loop, daemon=True)
+    with chaos.active(plan):
+        comp.start()
+        t.start()
+        submitted = []
+        for i in range(n_updates):
+            u = int(rng.integers(0, g.node_count))
+            v = int(rng.integers(0, g.node_count))
+            submitted.append(lane.submit(u, v))
+            time.sleep(0.002)
+        acks = [lane.results.get(timeout=10) for _ in range(n_updates)]
+        acked = max(o[2] for _, o in acks if isinstance(o, tuple))
+        # keep sampling until a compaction lands AND the sampler has
+        # observed a snapshot at least as new as the last acked update
+        deadline = time.time() + 30
+        while time.time() < deadline and (
+                counter_value("stream_compactions_total") < 1
+                or not versions or versions[-1] < acked):
+            time.sleep(0.02)
+    stop_sampling.set()
+    t.join(timeout=10)
+    lane.stop()
+    comp.stop()
+    try:
+        assert not errors, errors
+        # no dropped requests: every update answered, and answered ok
+        assert len(acks) == n_updates
+        assert all(isinstance(o, tuple) and o[0] == "ok" for _, o in acks)
+        # sampled versions are monotone non-decreasing...
+        assert versions == sorted(versions)
+        # ...and sampling caught up past every acked admission version
+        assert max(versions) >= acked >= max(
+            u.admitted_version for u in submitted)
+        # the deleted edge never reappeared, pre- or post-compaction
+        assert dead_seen == []
+        # the chaos fault fired AND a later compaction succeeded
+        assert counter_value("stream_compact_errors_total") == 1
+        assert counter_value("stream_compactions_total") >= 1
+        assert plan.hits("stream.compact") >= 2
+    finally:
+        g.close()
+
+
+# ================================================ telemetry contract
+def test_stream_metrics_ledger():
+    g = StreamingGraph(_star_topo(), delta_capacity=64)
+    try:
+        g.add_edges([0, 0], [20, 21])
+        g.remove_edges([0], [1])
+        snap = telemetry.snapshot()
+        assert counter_value("stream_edges_applied_total", op="add") == 2
+        assert counter_value("stream_tombstones_total") == 1
+        assert snap["gauges"][metric_key("stream_overlay_bytes", {})] > 0
+        compact(g)
+        snap = telemetry.snapshot()
+        assert counter_value("stream_compactions_total") == 1
+        assert snap["gauges"][metric_key("stream_overlay_bytes", {})] == 0
+        hkey = metric_key("stream_compact_pause_seconds", {})
+        assert sum(snap["histograms"][hkey]["counts"]) == 1
+    finally:
+        g.close()

@@ -71,3 +71,34 @@ def test_to_device_roundtrip(small_graph):
     np.testing.assert_array_equal(
         np.asarray(indptr)[: n + 1], small_graph.indptr.astype(np.int32)
     )
+
+
+def test_to_device_cache_is_per_device(small_graph):
+    import jax
+
+    devs = jax.devices()
+    a0, _ = small_graph.to_device(devs[0])
+    b0, _ = small_graph.to_device(devs[0])
+    assert a0 is b0                          # same device: cached
+    if len(devs) > 1:
+        a1, _ = small_graph.to_device(devs[1])
+        assert a1 is not a0                  # regression: the old single-slot
+        assert list(a1.devices()) == [devs[1]]  # cache served dev0's arrays
+        c0, _ = small_graph.to_device(devs[0])
+        assert c0 is a0                      # dev1 placement didn't evict dev0
+
+
+def test_to_device_invalidate_drops_stale_arrays(small_graph):
+    stale_indptr, stale_indices = small_graph.to_device()
+    v0 = small_graph.version
+    # mutate the topology in place (what the stream compactor's swap
+    # protects against) and invalidate
+    small_graph.indices_ = small_graph.indices_[::-1].copy()
+    small_graph.invalidate()
+    assert small_graph.version == v0 + 1
+    indptr, indices = small_graph.to_device()
+    assert indices is not stale_indices
+    e = small_graph.edge_count
+    np.testing.assert_array_equal(
+        np.asarray(indices)[:e], small_graph.indices.astype(np.int32)
+    )
