@@ -1,6 +1,6 @@
 # Top-level convenience targets (parity: reference ./configure && make).
 .PHONY: all native test test-quick test-native asan bench smoke \
-	telemetry-check chaos stream lint help
+	telemetry-check chaos stream lint sanitize help
 
 all: native
 
@@ -37,9 +37,17 @@ chaos:
 stream:
 	python -m pytest tests/ -m stream -q
 
-# quiverlint: hot-path static analysis (docs/STATIC_ANALYSIS.md)
+# quiverlint: hot-path + whole-program concurrency static analysis
+# (docs/STATIC_ANALYSIS.md); --strict-baseline also fails on stale
+# baseline entries so the debt ledger can only shrink
 lint:
-	python -m quiver_tpu.analysis quiver_tpu bench.py
+	python -m quiver_tpu.analysis --strict-baseline quiver_tpu bench.py
+
+# quick suite + chaos harness under the lock-witness sanitizer
+# (QUIVER_SANITIZE=1 wraps threading.Lock/RLock; docs/STATIC_ANALYSIS.md)
+sanitize:
+	QUIVER_SANITIZE=1 python -m pytest tests/ -m "not slow" -q
+	QUIVER_SANITIZE=1 python -m pytest tests/ -m chaos -q
 
 help:
-	@echo "targets: native | test | test-quick | test-native | asan | bench | smoke | telemetry-check | chaos | stream | lint"
+	@echo "targets: native | test | test-quick | test-native | asan | bench | smoke | telemetry-check | chaos | stream | lint | sanitize"

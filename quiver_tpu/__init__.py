@@ -21,6 +21,15 @@ Public API parity map (reference ``srcs/python/quiver/__init__.py:1-21``):
 
 import os as _os
 
+if _os.environ.get("QUIVER_SANITIZE") == "1":
+    # Lock-witness sanitizer (quiverlint v2's dynamic half): must patch
+    # threading.Lock/RLock BEFORE any other quiver module imports so
+    # module- and instance-level locks constructed below get wrapped.
+    # analysis.witness is stdlib-only — no jax cost on this path.
+    from .analysis import witness as _witness
+
+    _witness.install()
+
 if _os.environ.get("JAX_PLATFORMS"):
     # honor an explicit JAX_PLATFORMS even where a site hook re-exports
     # its own after env setup: the config API takes final precedence.

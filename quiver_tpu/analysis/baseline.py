@@ -26,7 +26,7 @@ from typing import List, Sequence, Tuple
 
 from .core import Finding
 
-__all__ = ["DEFAULT_BASELINE_NAME", "load", "save", "partition"]
+__all__ = ["DEFAULT_BASELINE_NAME", "load", "save", "partition", "stale"]
 
 DEFAULT_BASELINE_NAME = "quiverlint.baseline.json"
 _VERSION = 1
@@ -67,3 +67,29 @@ def partition(findings: Sequence[Finding],
         else:
             new.append(f)
     return new, known
+
+
+def stale(findings: Sequence[Finding],
+          baseline: Sequence[Finding]) -> List[Finding]:
+    """Baseline entries no longer matched by any current finding.
+
+    A stale entry is accepted debt that has since been fixed (or the
+    flagged line rewritten) without the baseline being re-recorded —
+    harmless until someone reintroduces the same violation and the dead
+    entry silently absorbs it.  ``--strict-baseline`` fails on these;
+    multiset semantics mirror :func:`partition` (two identical accepted
+    entries need two current findings to both stay live).
+    """
+    remaining = Counter(f.fingerprint() for f in baseline)
+    for f in findings:
+        fp = f.fingerprint()
+        if remaining.get(fp, 0) > 0:
+            remaining[fp] -= 1
+    out: List[Finding] = []
+    claimed: Counter = Counter()
+    for b in baseline:
+        fp = b.fingerprint()
+        if claimed[fp] < remaining.get(fp, 0):
+            claimed[fp] += 1
+            out.append(b)
+    return out

@@ -38,6 +38,11 @@ def build_parser() -> argparse.ArgumentParser:
                         f"{baseline_mod.DEFAULT_BASELINE_NAME} if present)")
     p.add_argument("--no-baseline", action="store_true",
                    help="ignore any baseline file")
+    p.add_argument("--strict-baseline", action="store_true",
+                   help="also fail when the baseline holds stale entries "
+                        "no longer reported (fixed debt must be removed "
+                        "from the baseline, not left to absorb the next "
+                        "regression)")
     p.add_argument("--write-baseline", action="store_true",
                    help="record current findings as the accepted baseline "
                         "and exit 0")
@@ -79,6 +84,7 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     known = []
     new = result.findings
+    stale = []
     if not args.no_baseline and baseline_path.exists():
         try:
             accepted = baseline_mod.load(baseline_path)
@@ -87,12 +93,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                   file=sys.stderr)
             return 2
         new, known = baseline_mod.partition(result.findings, accepted)
+        if args.strict_baseline:
+            stale = baseline_mod.stale(result.findings, accepted)
 
     if args.format == "json":
         print(json.dumps({
             "findings": [f.to_dict() for f in new],
             "baselined": [f.to_dict() for f in known],
             "suppressed": [f.to_dict() for f in result.suppressed],
+            "stale": [f.to_dict() for f in stale],
             "files": result.files,
             "errors": result.errors,
         }, indent=2))
@@ -101,13 +110,19 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f.format())
             if f.snippet:
                 print(f"    {f.snippet}")
+        for f in stale:
+            print(f"stale baseline entry (no longer reported): "
+                  f"{f.rule} {f.path} [{f.scope}] {f.snippet!r}")
         if args.show_suppressed:
             for f in result.suppressed:
                 print(f"suppressed: {f.format()}")
         print(f"quiverlint: {len(new)} new finding(s), "
               f"{len(known)} baselined, {len(result.suppressed)} "
-              f"suppressed across {result.files} file(s)")
+              f"suppressed across {result.files} file(s)"
+              + (f", {len(stale)} stale baseline entr"
+                 f"{'y' if len(stale) == 1 else 'ies'}"
+                 if args.strict_baseline else ""))
 
     if result.errors:
         return 2
-    return 1 if new else 0
+    return 1 if (new or stale) else 0

@@ -32,8 +32,9 @@ from pathlib import Path
 from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
 
 __all__ = [
-    "Finding", "LintConfig", "LintResult", "ModuleContext", "Rule",
-    "analyze_paths", "dotted_call_name", "iter_py_files",
+    "Finding", "LintConfig", "LintResult", "ModuleContext", "ProgramRule",
+    "Rule", "analyze_paths", "dotted_call_name", "iter_py_files",
+    "load_contexts",
 ]
 
 _SUPPRESS_RE = re.compile(r"#\s*quiverlint:\s*ignore\[([A-Za-z0-9_,\s]+)\]")
@@ -189,6 +190,29 @@ class Rule:
         yield  # pragma: no cover
 
 
+class ProgramRule(Rule):
+    """A rule over the *whole* analyzed program at once.
+
+    Per-file rules see one :class:`ModuleContext`; concurrency
+    properties (QT008 races, QT009 lock ordering, QT010 thread reaping)
+    need the interprocedural call graph spanning every file.  The
+    engine collects all contexts first, then runs each program rule's
+    :meth:`check_program` once.  Findings flow through the same
+    suppression / baseline machinery, keyed by the file each finding
+    lands in.
+    """
+
+    program = True
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        return iter(())
+
+    def check_program(self, ctxs: Sequence[ModuleContext],
+                      ) -> Iterator[Finding]:
+        raise NotImplementedError
+        yield  # pragma: no cover
+
+
 @dataclass
 class LintResult:
     findings: List[Finding] = field(default_factory=list)
@@ -286,6 +310,23 @@ def _relpath(path: Path, root: Path) -> str:
         return path.as_posix()
 
 
+def load_contexts(paths: Sequence, config: Optional[LintConfig] = None,
+                  root: Optional[Path] = None) -> List[ModuleContext]:
+    """Parse ``paths`` into :class:`ModuleContext` objects without running
+    any rules — the entry point for consumers that want the program model
+    alone (e.g. the lock-witness harness seeding the canonical order)."""
+    config = config or LintConfig()
+    root = Path(root) if root is not None else Path.cwd()
+    out: List[ModuleContext] = []
+    for f in iter_py_files(paths, root, config):
+        try:
+            out.append(ModuleContext(f, _relpath(f, root), f.read_text(),
+                                     config))
+        except (SyntaxError, UnicodeDecodeError, OSError):
+            continue
+    return out
+
+
 def analyze_paths(paths: Sequence, config: Optional[LintConfig] = None,
                   root: Optional[Path] = None) -> LintResult:
     """Run every (selected) rule over ``paths``; returns raw + suppressed
@@ -295,8 +336,12 @@ def analyze_paths(paths: Sequence, config: Optional[LintConfig] = None,
 
     config = config or LintConfig()
     root = Path(root) if root is not None else Path.cwd()
-    rules = [r for r in all_rules() if config.want_rule(r.code)]
+    selected = [r for r in all_rules() if config.want_rule(r.code)]
+    rules = [r for r in selected if not getattr(r, "program", False)]
+    program_rules = [r for r in selected if getattr(r, "program", False)]
     result = LintResult()
+    contexts: List[ModuleContext] = []
+    sups: Dict[str, Dict[int, Set[str]]] = {}
     for f in iter_py_files(paths, root, config):
         try:
             ctx = ModuleContext(f, _relpath(f, root), f.read_text(), config)
@@ -305,6 +350,8 @@ def analyze_paths(paths: Sequence, config: Optional[LintConfig] = None,
             continue
         result.files += 1
         sup = ctx.suppressions()
+        contexts.append(ctx)
+        sups[ctx.relpath] = sup
         for rule in rules:
             for finding in rule.check(ctx):
                 codes = sup.get(finding.line, ())
@@ -312,6 +359,13 @@ def analyze_paths(paths: Sequence, config: Optional[LintConfig] = None,
                     result.suppressed.append(finding)
                 else:
                     result.findings.append(finding)
+    for rule in program_rules:
+        for finding in rule.check_program(contexts):
+            codes = sups.get(finding.path, {}).get(finding.line, ())
+            if finding.rule.upper() in codes or "*" in codes:
+                result.suppressed.append(finding)
+            else:
+                result.findings.append(finding)
     result.findings.sort(key=lambda x: (x.path, x.line, x.rule))
     result.suppressed.sort(key=lambda x: (x.path, x.line, x.rule))
     return result

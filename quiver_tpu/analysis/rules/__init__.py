@@ -12,12 +12,16 @@ from .qt004_layering import ImportLayeringRule
 from .qt005_hygiene import HygieneRule
 from .qt006_metric_names import MetricNameRule
 from .qt007_silent_except import SilentExceptRule
+from .qt008_races import DataRaceRule
+from .qt009_lock_order import LockOrderRule
+from .qt010_thread_reap import ThreadReapRule
 
 __all__ = ["all_rules", "RULE_CLASSES"]
 
 RULE_CLASSES = (HostSyncRule, RetraceRule, LockDisciplineRule,
                 ImportLayeringRule, HygieneRule, MetricNameRule,
-                SilentExceptRule)
+                SilentExceptRule, DataRaceRule, LockOrderRule,
+                ThreadReapRule)
 
 
 def all_rules() -> List[Rule]:

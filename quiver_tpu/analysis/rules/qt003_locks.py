@@ -11,8 +11,11 @@ as a literal map::
 and this rule enforces that every *mutation* of a declared attribute
 (``self._fused_fns[...] = ...``, ``self._fused_fns.pop(...)``,
 rebinding, augmented assignment) happens lexically inside a
-``with self._lock:`` block naming the declared lock.  ``__init__`` is
-exempt (construction happens-before publication).  Reads are not
+``with self._lock:`` block naming the declared lock.  ``__init__`` and
+``__post_init__`` are exempt (construction happens-before publication),
+and so are ``@classmethod`` bodies — the alternate-constructor idiom
+builds an instance named ``self`` before publication, and a classmethod
+has no real ``self`` to mutate otherwise.  Reads are not
 checked: the codebase intentionally uses double-checked locking on
 CPython where a racy read is benign (e.g. ``MetricsRegistry._get``).
 """
@@ -92,8 +95,11 @@ class LockDisciplineRule(Rule):
                     if qual_base != "<module>" else cls.name)
         for stmt in cls.body:
             if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                if stmt.name == "__init__":
+                if stmt.name in ("__init__", "__post_init__"):
                     continue
+                if any(isinstance(d, ast.Name) and d.id == "classmethod"
+                       for d in stmt.decorator_list):
+                    continue  # alternate constructor: pre-publication
                 yield from self._walk(
                     ctx, stmt, guarded, frozenset(),
                     f"{cls_qual}.{stmt.name}")

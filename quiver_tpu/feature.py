@@ -103,7 +103,13 @@ class Feature:
     """
 
     _guarded_by = {"_pending": "_plock", "_stage_bufs": "_plock",
-                   "_overlay": "_plock"}
+                   "_overlay": "_plock",
+                   # published table state: writes swap atomically under
+                   # _plock; reads are lock-free (double-checked-read
+                   # contract shared with QT003/QT008)
+                   "hot": "_plock", "cold": "_plock",
+                   "feature_order": "_plock", "cache_count": "_plock",
+                   "node_count": "_plock", "dim": "_plock"}
 
     def __init__(self, rank: int = 0, device_list: Optional[Sequence] = None,
                  device_cache_size: Union[int, str] = 0,
@@ -177,28 +183,42 @@ class Feature:
         import jax.numpy as jnp
 
         tensor = np.asarray(tensor)
-        self.node_count, self.dim = tensor.shape
+        node_count, dim = tensor.shape
+        with self._plock:
+            self.node_count, self.dim = node_count, dim
         dt = self.dtype or tensor.dtype
-        row_bytes = int(np.dtype(dt).itemsize) * self.dim
+        row_bytes = int(np.dtype(dt).itemsize) * dim
         nd = self._n_devices()
-        cache_count = min(self._budget_rows(row_bytes, nd), self.node_count)
+        cache_count = min(self._budget_rows(row_bytes, nd), node_count)
 
+        new_order = None
+        topo_order = False
         if prob is not None and cache_count > 0:
             order = np.argsort(-np.asarray(prob), kind="stable")
-            new_order = np.empty(self.node_count, dtype=np.int64)
-            new_order[order] = np.arange(self.node_count)
+            new_order = np.empty(node_count, dtype=np.int64)
+            new_order[order] = np.arange(node_count)
             tensor = tensor[order]
-            self.feature_order = new_order
         elif self.csr_topo is not None and cache_count > 0:
-            ratio = cache_count / self.node_count
+            ratio = cache_count / node_count
             tensor, new_order = reindex_feature(self.csr_topo, tensor, ratio)
-            self.feature_order = new_order
-            self.csr_topo.feature_order = new_order
+            topo_order = True
 
-        self.cache_count = cache_count
         hot_np = np.ascontiguousarray(tensor[:cache_count], dtype=dt)
-        self.cold = np.ascontiguousarray(tensor[cache_count:], dtype=dt)
-        self.hot = self._place_hot(hot_np, dt)
+        cold_np = np.ascontiguousarray(tensor[cache_count:], dtype=dt)
+        hot = self._place_hot(hot_np, dt)
+        # Publish the table swap as one atomic step: gather-path readers
+        # are lock-free by policy (QT003/QT008 double-checked-read
+        # contract), so the swap must never be observable half-done.
+        # _maybe_enable_cold_cache stays OUTSIDE the lock — it
+        # re-acquires _plock (QT009 flags the nested self-acquire).
+        with self._plock:
+            if new_order is not None:
+                self.feature_order = new_order
+                if topo_order:
+                    self.csr_topo.feature_order = new_order
+            self.cache_count = cache_count
+            self.cold = cold_np
+            self.hot = hot
         self._maybe_enable_cold_cache()
         return self
 
@@ -271,7 +291,8 @@ class Feature:
         local_order = np.asarray(local_order)
         new_order = np.empty(self.node_count, dtype=np.int64)
         new_order[local_order] = np.arange(self.node_count)
-        self.feature_order = new_order
+        with self._plock:
+            self.feature_order = new_order
 
     # -- cold-row overlay cache (docs/FEATURE_CACHE.md) ----------------
     def _maybe_enable_cold_cache(self):
@@ -843,6 +864,7 @@ class Feature:
         if self._lazy_state is None:
             return
         cfg, hot, cold, order, cc, nc, dim = self._lazy_state
-        self.hot, self.cold, self.feature_order = hot, cold, order
-        self.cache_count, self.node_count, self.dim = cc, nc, dim
+        with self._plock:
+            self.hot, self.cold, self.feature_order = hot, cold, order
+            self.cache_count, self.node_count, self.dim = cc, nc, dim
         self._lazy_state = None

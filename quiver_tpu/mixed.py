@@ -23,6 +23,7 @@ from typing import Generic, Iterator, List, Sequence, TypeVar
 import numpy as np
 
 from . import telemetry
+from .resilience.shutdown import join_and_reap
 from .sampler import GraphSageSampler, SampledBatch
 from .utils.topology import CSRTopo
 
@@ -200,8 +201,7 @@ class MixedGraphSageSampler:
                 produced += 1
         finally:
             stop.set()
-            for th in threads:
-                th.join(timeout=5)
+            join_and_reap(threads, 5.0, component="mixed.cpu_workers")
         if tpu_times:
             self.avg_tpu_time = float(np.mean(tpu_times))
             telemetry.gauge("mixed_avg_task_seconds", lane="tpu").set(

@@ -194,6 +194,9 @@ class RequestBatcher:
             attrs = {"lane": lane}
             if load is not None and load != float("inf"):
                 attrs["load"] = load
+            # quiverlint: ignore[QT008] -- queue handoff orders the
+            # accesses: the producer stops touching req.trace once it is
+            # enqueued, and q.put/get gives the worker a happens-before
             req.trace.add("route", attrs)
         q.put(req)
 

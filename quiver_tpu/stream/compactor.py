@@ -35,6 +35,7 @@ import numpy as np
 
 from .. import telemetry
 from ..resilience import chaos
+from ..resilience.shutdown import join_and_reap
 from ..utils.topology import CSRTopo, coo_to_csr
 
 __all__ = ["compact", "Compactor"]
@@ -44,7 +45,7 @@ log = logging.getLogger("quiver_tpu.stream")
 _CHAOS_COMPACT = chaos.point("stream.compact")
 
 
-def compact(graph) -> dict:
+def compact(graph: "StreamingGraph") -> dict:
     """Fold ``graph``'s overlay into a fresh base CSR and swap it in.
 
     Returns fold stats; raises whatever the ``stream.compact`` chaos
@@ -101,7 +102,8 @@ class Compactor(threading.Thread):
     of delta capacity crosses ``watermark`` (checked every poll tick).
     """
 
-    def __init__(self, graph, interval_s: Optional[float] = None,
+    def __init__(self, graph: "StreamingGraph",
+                 interval_s: Optional[float] = None,
                  watermark: Optional[float] = None,
                  poll_s: float = 0.05):
         from ..config import get_config
@@ -141,4 +143,4 @@ class Compactor(threading.Thread):
 
     def stop(self, timeout: float = 5.0) -> None:
         self._stop_ev.set()
-        self.join(timeout=timeout)
+        join_and_reap([self], timeout, component="stream.compactor")

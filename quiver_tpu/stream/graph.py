@@ -104,7 +104,7 @@ class StreamingGraph:
     _guarded_by = {
         "_tomb": "_lock", "_delta": "_lock", "_version": "_lock",
         "_snap": "_lock", "_base": "_lock", "_base_ts": "_lock",
-        "_tombstones": "_lock",
+        "_tombstones": "_lock", "_listeners": "_lock",
     }
 
     def __init__(self, csr_topo: CSRTopo, edge_ts=None,
@@ -168,7 +168,8 @@ class StreamingGraph:
         touched node ids (edge endpoints).  Exceptions propagate to the
         mutator — a listener that cannot invalidate must not fail
         silently, or the caches serve stale rows."""
-        self._listeners.append(fn)
+        with self._lock:
+            self._listeners.append(fn)
 
     def attach_feature(self, feature) -> None:
         """Wire a ``Feature`` / ``DistFeature``'s ``invalidate_rows``."""
@@ -179,10 +180,18 @@ class StreamingGraph:
         flightrec.set_version_provider(None)
 
     def _notify(self, rows: np.ndarray) -> None:
-        if not self._listeners or rows.size == 0:
+        if rows.size == 0:
+            return
+        # snapshot under the lock, call listeners outside it: a listener
+        # (Feature.invalidate_rows) takes Feature._plock, and holding
+        # _lock across that call would pin the _lock -> _plock edge into
+        # every notification (see the class docstring's ordering note)
+        with self._lock:
+            listeners = list(self._listeners)
+        if not listeners:
             return
         rows = np.unique(rows.astype(np.int64))
-        for fn in self._listeners:
+        for fn in listeners:
             fn(rows)
 
     # -- mutation side -------------------------------------------------

@@ -73,7 +73,7 @@ class IngestLane:
     inline compaction and a retry — backpressure folds, it never drops.
     """
 
-    def __init__(self, graph, depth: Optional[int] = None,
+    def __init__(self, graph: "StreamingGraph", depth: Optional[int] = None,
                  deadline_ms: Optional[float] = None,
                  priority: Optional[int] = None,
                  result_queue=None, compact_on_full: bool = True):
@@ -170,8 +170,10 @@ class IngestLane:
                 self.results.put((item, e))
 
     def stop(self, timeout: float = 5.0) -> None:
+        from ..resilience.shutdown import join_and_reap
+
         self.lane.put(_STOP)
-        self._thread.join(timeout=timeout)
+        join_and_reap([self._thread], timeout, component="stream.ingest")
 
     @property
     def depth(self) -> int:

@@ -199,9 +199,12 @@ class MetricsServer:
         return f"http://{host}:{port}"
 
     def close(self) -> None:
+        # local import: resilience.shutdown itself imports telemetry
+        from ..resilience.shutdown import join_and_reap
+
         self._httpd.shutdown()
         self._httpd.server_close()
-        self._thread.join(timeout=5)
+        join_and_reap([self._thread], 5.0, component="telemetry.export")
 
 
 def start_http_server(port: int = 0, host: str = "127.0.0.1",

@@ -217,10 +217,14 @@ class SLOWatchdog:
         return self
 
     def stop(self) -> None:
+        # local import: resilience.shutdown itself imports telemetry
+        from ..resilience.shutdown import join_and_reap
+
         self._stop.set()
         t = self._thread
         if t is not None:
-            t.join(timeout=max(self.interval_s * 2, 1.0))
+            join_and_reap([t], max(self.interval_s * 2, 1.0),
+                          component="telemetry.slo")
             self._thread = None
 
 

@@ -30,6 +30,39 @@ import pytest
 from quiver_tpu.analysis.retrace_guard import *  # noqa: F401,F403
 
 
+# ---------------------------------------------------------------------------
+# Lock-witness sanitizer harness (`make sanitize` sets QUIVER_SANITIZE=1;
+# quiver_tpu/__init__.py installed the witness before jax even imported).
+# Seed the canonical acquisition order once from the static analyzer, then
+# drain after every test and fail the owner on any recorded violation.
+_SANITIZING = os.environ.get("QUIVER_SANITIZE") == "1"
+
+if _SANITIZING:
+    from quiver_tpu.analysis import witness as _witness
+
+    @pytest.fixture(scope="session", autouse=True)
+    def _witness_seed():
+        from quiver_tpu.analysis.concurrency import canonical_lock_edges
+        from quiver_tpu.analysis.core import load_contexts
+
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        ctxs = load_contexts([os.path.join(root, "quiver_tpu")])
+        _witness.seed_order(canonical_lock_edges(ctxs))
+        yield
+
+    @pytest.fixture(autouse=True)
+    def _witness_drain(request):
+        _witness.drain()  # don't blame this test for prior leftovers
+        yield
+        vs = _witness.drain()
+        if vs:
+            lines = [f"  [{v.kind}] {v.message} (thread {v.thread})"
+                     for v in vs]
+            pytest.fail(
+                "lock-witness sanitizer recorded %d violation(s):\n%s"
+                % (len(vs), "\n".join(lines)), pytrace=False)
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(0)

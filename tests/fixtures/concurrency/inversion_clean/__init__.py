@@ -1,0 +1,1 @@
+"""True-negative twins of inversion_seeded — see ../README.md."""

@@ -1,0 +1,35 @@
+"""inversion_seeded/pair.py, ordered: every path takes a before b, and
+the re-entrant path uses an RLock (re-entry is its contract) — QT009
+must stay quiet on both.
+"""
+
+import threading
+
+
+class Pair:
+    def __init__(self):
+        self.a = threading.Lock()
+        self.b = threading.Lock()
+
+    def forward(self):
+        with self.a:
+            with self.b:
+                pass
+
+    def also_forward(self):
+        with self.a:
+            with self.b:
+                pass
+
+
+class Reenter:
+    def __init__(self):
+        self.lock = threading.RLock()
+
+    def outer(self):
+        with self.lock:
+            self._inner()
+
+    def _inner(self):
+        with self.lock:
+            pass

@@ -1,0 +1,1 @@
+"""Seeded QT009 true positives — see ../README.md."""

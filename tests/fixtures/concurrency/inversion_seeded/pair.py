@@ -1,0 +1,35 @@
+"""Two QT009 shapes: an A→B / B→A cycle between two public entry
+points, and a plain-Lock self-deadlock reached interprocedurally (the
+callee's must-hold entry set carries the lock into a second acquire).
+"""
+
+import threading
+
+
+class Pair:
+    def __init__(self):
+        self.a = threading.Lock()
+        self.b = threading.Lock()
+
+    def forward(self):
+        with self.a:
+            with self.b:
+                pass
+
+    def backward(self):
+        with self.b:
+            with self.a:
+                pass
+
+
+class Reenter:
+    def __init__(self):
+        self.lock = threading.Lock()
+
+    def outer(self):
+        with self.lock:
+            self._inner()
+
+    def _inner(self):
+        with self.lock:  # entry_must carries `lock`: self-deadlock
+            pass

@@ -1,0 +1,1 @@
+"""True-negative twins of race_seeded — see ../README.md."""

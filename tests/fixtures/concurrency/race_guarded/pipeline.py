@@ -1,0 +1,35 @@
+"""race_seeded/pipeline.py with the lock actually taken.
+
+``progress`` is declared in ``_guarded_by`` and every write (worker and
+main root alike) holds ``_lock`` — QT008 and QT003 must both stay quiet.
+"""
+
+import threading
+
+from quiver_tpu.resilience.shutdown import join_and_reap
+
+
+class Pipeline:
+    _guarded_by = {"progress": "_lock"}
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.progress = 0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+
+    def start(self):
+        self._thread.start()
+
+    def _loop(self):
+        while not self._stop.is_set():
+            with self._lock:
+                self.progress += 1
+
+    def reset(self):
+        with self._lock:
+            self.progress = 0
+
+    def stop(self):
+        self._stop.set()
+        join_and_reap([self._thread], 1.0, component="fixture.pipeline")

@@ -1,0 +1,40 @@
+"""race_seeded/store.py with every contract honored.
+
+The cross-object write holds the declared lock, and the requires-lock
+callee is invoked under it — clean under QT008.
+"""
+
+import threading
+
+
+class Store:
+    _guarded_by = {"rows": "_lock"}
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.rows = []
+
+    def put(self, row):
+        with self._lock:
+            self.rows.append(row)
+
+
+def rebuild(store: "Store"):
+    with store._lock:
+        store.rows = []
+
+
+class Segment:
+    """Externally synchronized: callers hold ``Store._lock``."""
+
+    def __init__(self):
+        self.count = 0
+
+    # quiverlint: requires-lock[Store._lock]
+    def flush(self):
+        self.count = 0
+
+
+def tick(store: "Store", seg: "Segment"):
+    with store._lock:
+        seg.flush()

@@ -1,0 +1,1 @@
+"""Seeded QT008 true positives — see ../README.md."""

@@ -1,0 +1,32 @@
+"""Undeclared shared attribute written from two thread roots, no lock.
+
+``Pipeline.progress`` is written by the worker thread (`_loop`) and by
+the main root (`reset`) with no common lock and no `_guarded_by` entry:
+QT008's undeclared-attribute check must flag it.
+"""
+
+import threading
+
+from quiver_tpu.resilience.shutdown import join_and_reap
+
+
+class Pipeline:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.progress = 0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+
+    def start(self):
+        self._thread.start()
+
+    def _loop(self):
+        while not self._stop.is_set():
+            self.progress += 1  # worker write, unguarded
+
+    def reset(self):
+        self.progress = 0  # main write, unguarded
+
+    def stop(self):
+        self._stop.set()
+        join_and_reap([self._thread], 1.0, component="fixture.pipeline")

@@ -1,0 +1,41 @@
+"""Contract violations the per-file lexical rule (QT003) cannot see.
+
+``rebuild`` writes ``store.rows`` through a non-self reference without
+``Store._lock`` (cross-object past the `_guarded_by` contract), and
+``tick`` calls the requires-lock ``Segment.flush`` without holding the
+named lock: both are QT008's whole-program job.
+"""
+
+import threading
+
+
+class Store:
+    _guarded_by = {"rows": "_lock"}
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.rows = []
+
+    def put(self, row):
+        with self._lock:
+            self.rows.append(row)
+
+
+def rebuild(store: "Store"):
+    store.rows = []  # cross-object write, lock not held
+
+
+class Segment:
+    """Externally synchronized, like the real delta segment: callers
+    must hold ``Store._lock`` (no ``_guarded_by`` of its own)."""
+
+    def __init__(self):
+        self.count = 0
+
+    # quiverlint: requires-lock[Store._lock]
+    def flush(self):
+        self.count = 0
+
+
+def tick(seg: "Segment"):
+    seg.flush()  # requires-lock callee, lock not held

@@ -1,0 +1,497 @@
+"""quiverlint v2 whole-program concurrency tests (QT008/QT009/QT010).
+
+Two layers:
+
+* model + rule unit tests over tmp_path fixtures, through the real
+  ``analyze_paths`` / ``build_program`` entry points (same idiom as
+  ``test_quiverlint_rules.py``);
+* end-to-end CLI gates over the on-disk packages in
+  ``tests/fixtures/concurrency/`` — seeded bugs must exit 1 with exactly
+  the expected rule, clean twins must exit 0.
+"""
+
+import json
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from quiver_tpu.analysis import LintConfig, analyze_paths
+from quiver_tpu.analysis.concurrency import (
+    build_program,
+    canonical_lock_edges,
+)
+from quiver_tpu.analysis.concurrency.program import MAIN_ROOT
+from quiver_tpu.analysis.core import load_contexts
+
+REPO = Path(__file__).resolve().parents[1]
+FIXTURES = REPO / "tests" / "fixtures" / "concurrency"
+
+# referencing join_and_reap satisfies QT010 so unrelated fixtures stay
+# single-rule; the fixtures never execute, imports are never resolved
+REAP = "from quiver_tpu.resilience.shutdown import join_and_reap\n"
+
+
+def run_lint(tmp_path, source, name="mod.py", prelude=""):
+    p = tmp_path / name
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(prelude + textwrap.dedent(source))
+    result = analyze_paths([str(p)], config=LintConfig(), root=tmp_path)
+    assert result.errors == [], result.errors  # fixture must parse
+    return result
+
+
+def prog_of(tmp_path, source, name="mod.py"):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(source))
+    return build_program(load_contexts([str(p)], root=tmp_path))
+
+
+def codes(result):
+    return sorted(f.rule for f in result.findings)
+
+
+# ------------------------------------------------------ call graph/roots
+class TestProgramModel:
+    def test_thread_root_discovery_and_reachability(self, tmp_path):
+        prog = prog_of(tmp_path, """
+            import threading
+
+            class Worker:
+                def __init__(self):
+                    self._t = threading.Thread(target=self._run)
+
+                def _run(self):
+                    self._step()
+
+                def _step(self):
+                    pass
+
+            def entry():
+                pass
+        """)
+        run_roots = prog.roots_of["mod:Worker._run"]
+        assert run_roots and MAIN_ROOT not in run_roots
+        # reachability: the root flows through the call edge into _step
+        assert prog.roots_of["mod:Worker._step"] == run_roots
+        # public module function seeds the synthetic main root
+        assert MAIN_ROOT in prog.roots_of["mod:entry"]
+
+    def test_must_lock_entry_set_is_intersection(self, tmp_path):
+        prog = prog_of(tmp_path, """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def locked(self):
+                    with self._lock:
+                        self._work()
+
+                def also_locked(self):
+                    with self._lock:
+                        self._work()
+
+                def unlocked(self):
+                    self._work()
+
+                def _work(self):
+                    pass
+
+                def only_locked(self):
+                    with self._lock:
+                        self._deep()
+
+                def _deep(self):
+                    pass
+            """)
+        # _work: one caller holds nothing -> intersection is empty
+        assert prog.entry_must["mod:C._work"] == frozenset()
+        # _deep: private, every caller chain holds the lock
+        deep = prog.entry_must["mod:C._deep"]
+        assert {(l.owner, l.attr) for l in deep} == {("mod:C", "_lock")}
+
+    def test_canonical_lock_edges_vocabulary(self, tmp_path):
+        p = tmp_path / "mod.py"
+        p.write_text(textwrap.dedent("""
+            import threading
+
+            class C:
+                def __init__(self):
+                    self.a = threading.Lock()
+                    self.b = threading.Lock()
+
+                def nest(self):
+                    with self.a:
+                        with self.b:
+                            pass
+        """))
+        edges = canonical_lock_edges(load_contexts([str(p)], root=tmp_path))
+        assert ("C.a", "C.b") in edges
+        assert ("C.b", "C.a") not in edges
+
+
+# ------------------------------------------------------------ QT008
+class TestDataRace:
+    def test_undeclared_two_root_write_flagged(self, tmp_path):
+        r = run_lint(tmp_path, prelude=REAP, source="""
+            import threading
+
+            class P:
+                def __init__(self):
+                    self.n = 0
+                    self._t = threading.Thread(target=self._run)
+
+                def _run(self):
+                    self.n += 1
+
+                def bump(self):
+                    self.n = 0
+
+                def stop(self):
+                    join_and_reap([self._t], 1.0, component="t")
+        """)
+        assert codes(r) == ["QT008"]
+        assert "2 thread roots" in r.findings[0].message
+
+    def test_common_lock_on_every_write_is_clean(self, tmp_path):
+        r = run_lint(tmp_path, prelude=REAP, source="""
+            import threading
+
+            class P:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.n = 0
+                    self._t = threading.Thread(target=self._run)
+
+                def _run(self):
+                    with self._lock:
+                        self.n += 1
+
+                def bump(self):
+                    with self._lock:
+                        self.n = 0
+
+                def stop(self):
+                    join_and_reap([self._t], 1.0, component="t")
+        """)
+        assert r.findings == []
+
+    def test_single_root_attr_is_clean(self, tmp_path):
+        r = run_lint(tmp_path, """
+            class P:
+                def __init__(self):
+                    self.n = 0
+
+                def bump(self):
+                    self.n += 1
+        """)
+        assert r.findings == []
+
+    def test_cross_object_declared_write_needs_lock(self, tmp_path):
+        r = run_lint(tmp_path, """
+            import threading
+
+            class Store:
+                _guarded_by = {"rows": "_lock"}
+
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.rows = []
+
+            def racy(store: "Store"):
+                store.rows = []
+
+            def fine(store: "Store"):
+                with store._lock:
+                    store.rows = []
+        """)
+        assert codes(r) == ["QT008"]
+        assert r.findings[0].scope == "racy"
+        assert "_guarded_by" in r.findings[0].message
+
+    def test_interprocedural_must_lock_guards_callee_write(self, tmp_path):
+        # _apply only ever runs under the lock: its write is guarded by
+        # the propagated entry set, not lexically
+        r = run_lint(tmp_path, """
+            import threading
+
+            class Store:
+                _guarded_by = {"rows": "_lock"}
+
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.rows = []
+
+            def _apply(store: "Store"):
+                store.rows = []
+
+            def outer(store: "Store"):
+                with store._lock:
+                    _apply(store)
+        """)
+        assert r.findings == []
+
+    def test_requires_lock_directive_trusts_body_checks_callers(
+            self, tmp_path):
+        src = """
+            import threading
+
+            class Store:
+                _guarded_by = {"rows": "_lock"}
+
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.rows = []
+
+            class Segment:
+                def __init__(self):
+                    self.count = 0
+
+                # quiverlint: requires-lock[Store._lock]
+                def flush(self):
+                    self.count = 0
+
+            def good(store: "Store", seg: "Segment"):
+                with store._lock:
+                    seg.flush()
+        """
+        assert run_lint(tmp_path, src).findings == []
+        r = run_lint(tmp_path, textwrap.dedent(src) + textwrap.dedent("""
+            def bad(seg: "Segment"):
+                seg.flush()
+        """))
+        assert codes(r) == ["QT008"]
+        assert "requires-lock" in r.findings[0].message
+        assert r.findings[0].scope == "bad"
+
+    def test_fresh_local_prepublication_write_is_clean(self, tmp_path):
+        r = run_lint(tmp_path, """
+            import threading
+
+            class Store:
+                _guarded_by = {"rows": "_lock"}
+
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.rows = []
+
+            def make():
+                s = Store()
+                s.rows = [1]  # not yet published: no lock needed
+                return s
+        """)
+        assert r.findings == []
+
+    def test_sync_primitive_attr_is_exempt(self, tmp_path):
+        r = run_lint(tmp_path, prelude=REAP, source="""
+            import threading
+
+            class W:
+                def __init__(self):
+                    self._stop = threading.Event()
+                    self._t = threading.Thread(target=self._run)
+
+                def _run(self):
+                    while not self._stop.is_set():
+                        pass
+
+                def reset(self):
+                    self._stop.clear()
+
+                def stop(self):
+                    join_and_reap([self._t], 1.0, component="t")
+        """)
+        assert r.findings == []
+
+    def test_suppression_comment_silences_qt008(self, tmp_path):
+        r = run_lint(tmp_path, prelude=REAP, source="""
+            import threading
+
+            class P:
+                def __init__(self):
+                    self.n = 0
+                    self._t = threading.Thread(target=self._run)
+
+                def _run(self):
+                    # quiverlint: ignore[QT008] -- test fixture
+                    self.n += 1
+
+                def bump(self):
+                    self.n = 0
+
+                def stop(self):
+                    join_and_reap([self._t], 1.0, component="t")
+        """)
+        assert r.findings == []
+        assert [f.rule for f in r.suppressed] == ["QT008"]
+
+
+# ------------------------------------------------------------ QT009
+class TestLockOrder:
+    def test_ab_ba_cycle_flagged(self, tmp_path):
+        r = run_lint(tmp_path, """
+            import threading
+
+            class Pair:
+                def __init__(self):
+                    self.a = threading.Lock()
+                    self.b = threading.Lock()
+
+                def fwd(self):
+                    with self.a:
+                        with self.b:
+                            pass
+
+                def bwd(self):
+                    with self.b:
+                        with self.a:
+                            pass
+        """)
+        assert codes(r) == ["QT009"]
+        assert "inversion" in r.findings[0].message
+
+    def test_consistent_order_is_clean(self, tmp_path):
+        r = run_lint(tmp_path, """
+            import threading
+
+            class Pair:
+                def __init__(self):
+                    self.a = threading.Lock()
+                    self.b = threading.Lock()
+
+                def fwd(self):
+                    with self.a:
+                        with self.b:
+                            pass
+
+                def fwd2(self):
+                    with self.a:
+                        with self.b:
+                            pass
+        """)
+        assert r.findings == []
+
+    def test_plain_lock_reacquire_via_callee_flagged(self, tmp_path):
+        r = run_lint(tmp_path, """
+            import threading
+
+            class R:
+                def __init__(self):
+                    self.lock = threading.Lock()
+
+                def outer(self):
+                    with self.lock:
+                        self._inner()
+
+                def _inner(self):
+                    with self.lock:
+                        pass
+        """)
+        assert codes(r) == ["QT009"]
+        assert "self-deadlock" in r.findings[0].message
+
+    def test_rlock_reentry_is_clean(self, tmp_path):
+        r = run_lint(tmp_path, """
+            import threading
+
+            class R:
+                def __init__(self):
+                    self.lock = threading.RLock()
+
+                def outer(self):
+                    with self.lock:
+                        self._inner()
+
+                def _inner(self):
+                    with self.lock:
+                        pass
+        """)
+        assert r.findings == []
+
+
+# ------------------------------------------------------------ QT010
+class TestThreadReap:
+    def test_unreaped_thread_root_flagged(self, tmp_path):
+        r = run_lint(tmp_path, """
+            import threading
+
+            class W:
+                def __init__(self):
+                    self._t = threading.Thread(target=self._run)
+                    self._t.start()
+
+                def _run(self):
+                    pass
+        """)
+        assert codes(r) == ["QT010"]
+
+    def test_join_and_reap_reference_satisfies(self, tmp_path):
+        r = run_lint(tmp_path, prelude=REAP, source="""
+            import threading
+
+            class W:
+                def __init__(self):
+                    self._t = threading.Thread(target=self._run)
+                    self._t.start()
+
+                def _run(self):
+                    pass
+
+                def stop(self):
+                    join_and_reap([self._t], 1.0, component="t")
+        """)
+        assert r.findings == []
+
+    def test_submit_on_borrowed_pool_not_flagged(self, tmp_path):
+        # the pool is a parameter: the caller owns its lifecycle, so
+        # there is nothing for this scope to reap (QT003 regression
+        # fixtures rely on this staying quiet)
+        r = run_lint(tmp_path, """
+            class S:
+                def schedule(self, pool, k):
+                    pool.submit(lambda: k)
+        """)
+        assert r.findings == []
+
+    def test_submit_on_owned_pool_still_flagged(self, tmp_path):
+        r = run_lint(tmp_path, """
+            from concurrent.futures import ThreadPoolExecutor
+
+            class S:
+                def __init__(self):
+                    self._pool = ThreadPoolExecutor(2)
+
+                def schedule(self, k):
+                    self._pool.submit(lambda: k)
+        """)
+        assert codes(r) == ["QT010"]
+
+
+# --------------------------------------------------- fixture package e2e
+def _cli_json(target):
+    proc = subprocess.run(
+        [sys.executable, "-m", "quiver_tpu.analysis", str(target),
+         "--format", "json"],
+        capture_output=True, text=True, timeout=300, cwd=str(REPO))
+    return proc.returncode, json.loads(proc.stdout)
+
+
+@pytest.mark.parametrize("pkg, rules", [
+    ("race_seeded", {"QT008"}),
+    ("inversion_seeded", {"QT009"}),
+])
+def test_seeded_fixture_fails_cli(pkg, rules):
+    rc, doc = _cli_json(FIXTURES / pkg)
+    assert rc == 1
+    assert {f["rule"] for f in doc["findings"]} == rules
+
+
+@pytest.mark.parametrize("pkg", ["race_guarded", "inversion_clean"])
+def test_clean_fixture_passes_cli(pkg):
+    rc, doc = _cli_json(FIXTURES / pkg)
+    assert rc == 0, doc
+    assert doc["findings"] == []

@@ -49,7 +49,57 @@ def _repo_copy_with(tmp_path, relpath, appended):
     return tmp_path
 
 
+def test_cli_strict_baseline_clean_on_repo():
+    """No stale baseline entries: every accepted finding must still be
+    reported (the debt ledger only shrinks)."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "quiver_tpu.analysis", "--strict-baseline",
+         *LINT_TARGETS],
+        capture_output=True, text=True, timeout=300, cwd=str(REPO))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_stale_baseline_entry_fails_only_under_strict(tmp_path):
+    shutil.copytree(REPO / "quiver_tpu", tmp_path / "quiver_tpu")
+    shutil.copy(REPO / "bench.py", tmp_path / "bench.py")
+    doc = json.loads(
+        (REPO / baseline_mod.DEFAULT_BASELINE_NAME).read_text())
+    doc["findings"].append({
+        "rule": "QT001", "path": "quiver_tpu/sampler.py", "line": 1,
+        "col": 0, "scope": "ghost", "message": "fixed long ago",
+        "snippet": "x = jax.device_get(y)"})
+    (tmp_path / baseline_mod.DEFAULT_BASELINE_NAME).write_text(
+        json.dumps(doc))
+    base_cmd = [sys.executable, "-m", "quiver_tpu.analysis", *LINT_TARGETS]
+    lax = subprocess.run(base_cmd, capture_output=True, text=True,
+                         timeout=300, cwd=str(tmp_path))
+    assert lax.returncode == 0, lax.stdout + lax.stderr
+    strict = subprocess.run(base_cmd + ["--strict-baseline"],
+                            capture_output=True, text=True, timeout=300,
+                            cwd=str(tmp_path))
+    assert strict.returncode == 1, strict.stdout + strict.stderr
+    assert "stale baseline entry" in strict.stdout
+
+
 @pytest.mark.parametrize("relpath, code, appended", [
+    ("quiver_tpu/feature.py", "QT008",
+     "\n\ndef _racy_publish(feat: \"Feature\"):\n"
+     "    feat.hot = None\n"),
+    ("quiver_tpu/serving.py", "QT009",
+     "\n\nclass _Inverted:\n"
+     "    def __init__(self):\n"
+     "        self._qa = threading.Lock()\n"
+     "        self._qb = threading.Lock()\n"
+     "\n"
+     "    def fwd(self):\n"
+     "        with self._qa:\n"
+     "            with self._qb:\n"
+     "                pass\n"
+     "\n"
+     "    def bwd(self):\n"
+     "        with self._qb:\n"
+     "            with self._qa:\n"
+     "                pass\n"),
     ("quiver_tpu/sampler.py", "QT001",
      "\n\ndef _leaky(x):\n"
      "    import jax\n"

@@ -494,3 +494,54 @@ def test_stream_metrics_ledger():
         assert sum(snap["histograms"][hkey]["counts"]) == 1
     finally:
         g.close()
+
+
+# ===================================== concurrency-fix regressions
+def test_reentrant_listener_registration_does_not_deadlock():
+    """_notify snapshots the listener list and calls back OUTSIDE _lock;
+    a listener that registers another listener (or mutates the graph's
+    listener set any other way) must therefore not self-deadlock.  Run
+    the mutation in a worker so a regression fails the join timeout
+    instead of hanging the suite."""
+    g = StreamingGraph(_star_topo(), delta_capacity=64)
+    try:
+        late_rows = []
+
+        def reentrant(rows):
+            g.register_invalidation(late_rows.append)
+
+        g.register_invalidation(reentrant)
+        t = threading.Thread(target=lambda: g.add_edges([0], [50]),
+                             daemon=True)
+        t.start()
+        t.join(timeout=10)
+        assert not t.is_alive(), \
+            "notification deadlocked on re-entrant register_invalidation"
+        # the late listener is live for the NEXT mutation
+        g.add_edges([0], [51])
+        assert late_rows and 51 in np.asarray(late_rows[-1])
+    finally:
+        g.close()
+
+
+def test_ingest_and_compactor_threads_are_reaped():
+    """stop() must run the threads down through join_and_reap: nothing
+    left alive, and the leak counter untouched."""
+    g = StreamingGraph(_star_topo(), delta_capacity=64)
+    lane = IngestLane(g, depth=8).start()
+    comp = Compactor(g, interval_s=30.0)
+    comp.start()
+    try:
+        lane.submit(0, 42)
+        lane.results.get(timeout=5)
+    finally:
+        lane.stop()
+        comp.stop()
+        g.close()
+    assert not comp.is_alive()
+    assert not any(th.name.startswith(("stream-ingest", "stream-compact"))
+                   for th in threading.enumerate() if th.is_alive())
+    assert counter_value("serving_thread_leak_total",
+                         component="stream.ingest") == 0
+    assert counter_value("serving_thread_leak_total",
+                         component="stream.compactor") == 0

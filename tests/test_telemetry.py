@@ -563,3 +563,32 @@ class TestConcurrentMergeSnapshot:
             assert snap["counters"][key] == n_rounds
         h = snap["histograms"]["hammer_seconds"]
         assert sum(h["counts"]) == n_workers * n_rounds
+
+
+# ===================================== concurrency-fix regressions
+class TestThreadReaping:
+    """stop()/close() must run worker threads down via join_and_reap
+    (QT010's contract) — nothing alive afterwards, no leak tick."""
+
+    def test_slo_watchdog_stop_reaps(self):
+        import threading
+
+        from quiver_tpu.telemetry.slo import SLOWatchdog
+
+        wd = SLOWatchdog(interval_s=0.05).start()
+        t = wd._thread
+        assert t.is_alive()
+        wd.stop()
+        assert not t.is_alive()
+        assert wd._thread is None
+        assert not any(th.name == "quiver-slo-watchdog"
+                       for th in threading.enumerate() if th.is_alive())
+
+    def test_metrics_server_close_reaps(self):
+        from quiver_tpu.telemetry.export import start_http_server
+
+        srv = start_http_server(port=0)
+        t = srv._thread
+        assert t.is_alive()
+        srv.close()
+        assert not t.is_alive()

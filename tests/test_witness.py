@@ -1,0 +1,231 @@
+"""Lock-witness sanitizer tests (quiverlint v2's dynamic half).
+
+The install/uninstall fixture drives the witness directly so these run
+in the normal suite too; under ``make sanitize`` (QUIVER_SANITIZE=1)
+install() is a no-op on the already-installed witness and teardown
+leaves it in place for the rest of the session.
+
+The inversion test is deliberately deterministic: thread 1 takes A→B
+and fully exits before thread 2 takes B→A, so no interleaving luck is
+involved — the order graph, not an actual deadlock, raises the flag.
+"""
+
+import os
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+from quiver_tpu.analysis import witness
+
+pytestmark = pytest.mark.sanitize
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+@pytest.fixture
+def w():
+    was_installed = witness.installed()
+    witness.install()
+    witness.drain()
+    yield witness
+    witness.drain()
+    if not was_installed:  # don't tear down the session-wide sanitizer
+        witness.uninstall()
+
+
+def kinds(vs):
+    return sorted(v.kind for v in vs)
+
+
+def test_wraps_lock_construction(w):
+    assert isinstance(threading.Lock(), witness._WitnessLock)
+    assert isinstance(threading.RLock(), witness._WitnessLock)
+
+
+def test_deterministic_two_thread_inversion(w):
+    class Box:
+        def __init__(self):
+            self.alock = threading.Lock()
+            self.block = threading.Lock()
+
+    b = Box()
+
+    def fwd():
+        with b.alock:
+            with b.block:
+                pass
+
+    def bwd():
+        with b.block:
+            with b.alock:
+                pass
+
+    t1 = threading.Thread(target=fwd)
+    t1.start()
+    t1.join()  # A->B fully witnessed before the reverse order runs
+    t2 = threading.Thread(target=bwd)
+    t2.start()
+    t2.join()
+    vs = w.drain()
+    assert "lock-order" in kinds(vs), vs
+    msg = next(v for v in vs if v.kind == "lock-order").message
+    assert "Box.alock" in msg and "Box.block" in msg
+
+
+def test_consistent_order_stays_quiet(w):
+    class Box:
+        def __init__(self):
+            self.alock = threading.Lock()
+            self.block = threading.Lock()
+
+    b = Box()
+    for _ in range(3):
+        with b.alock:
+            with b.block:
+                pass
+    assert w.drain() == []
+
+
+def test_seeded_static_order_flags_single_reversal(w):
+    w.seed_order([("SeedA._first", "SeedB._second")])
+
+    class SeedA:
+        def __init__(self):
+            self._first = threading.Lock()
+
+    class SeedB:
+        def __init__(self):
+            self._second = threading.Lock()
+
+    a, b = SeedA(), SeedB()
+    with b._second:      # the reverse order, exactly once
+        with a._first:
+            pass
+    vs = w.drain()
+    assert kinds(vs) == ["lock-order"]
+    assert "canonical order" in vs[0].message
+
+
+def test_plain_lock_reentry_recorded_not_hung(w):
+    lock = threading.Lock()
+    lock.acquire()
+    assert lock.acquire(timeout=0.01) is False  # delegates, returns
+    lock.release()
+    assert "self-deadlock" in kinds(w.drain())
+
+
+def test_rlock_reentry_is_fine(w):
+    lock = threading.RLock()
+    with lock:
+        with lock:
+            pass
+    assert w.drain() == []
+
+
+def test_guarded_write_enforcement(w):
+    class G:
+        _guarded_by = {"val": "_lock"}
+
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.val = 0  # construction frame: exempt
+
+    g = G()
+    with g._lock:
+        g.val = 1  # held: fine
+    assert w.drain() == []
+    g.val = 2  # unguarded rebind
+    vs = w.drain()
+    assert kinds(vs) == ["unguarded-write"]
+    assert "G.val" in vs[0].message
+
+
+def test_condition_over_witnessed_lock(w):
+    cv = threading.Condition(threading.Lock())
+    got = []
+
+    def waiter():
+        with cv:
+            got.append(cv.wait(timeout=2.0))
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.05)
+    with cv:
+        cv.notify_all()
+    t.join()
+    assert got == [True]
+    assert w.drain() == []
+
+
+def test_feature_publication_is_witness_clean(w):
+    """Regression for the Feature table-swap fix: constructing a Feature
+    and re-publishing its order must honor the _guarded_by contract
+    under the sanitizer (locks here were made AFTER install, so the
+    wrapped __setattr__ checks are live)."""
+    np = pytest.importorskip("numpy")
+    from quiver_tpu.feature import Feature
+
+    feat = Feature(rank=0, device_list=[0])
+    feat.from_cpu_tensor(np.arange(20, dtype=np.float32).reshape(5, 4))
+    # re-publication takes the same atomic-swap path on a live object
+    feat.from_cpu_tensor(np.ones((6, 3), dtype=np.float32))
+    vs = w.drain()
+    assert vs == [], vs
+
+
+def test_witness_off_is_zero_overhead():
+    """Without QUIVER_SANITIZE, importing quiver_tpu must neither load
+    the witness nor touch the Lock factories."""
+    env = {k: v for k, v in os.environ.items() if k != "QUIVER_SANITIZE"}
+    env["JAX_PLATFORMS"] = "cpu"
+    code = (
+        "import threading, _thread, sys\n"
+        "orig_l, orig_r = threading.Lock, threading.RLock\n"
+        "import quiver_tpu\n"
+        "assert 'quiver_tpu.analysis.witness' not in sys.modules\n"
+        "assert threading.Lock is orig_l is _thread.allocate_lock\n"
+        "assert threading.RLock is orig_r\n"
+    )
+    proc = subprocess.run([sys.executable, "-c", code],
+                          capture_output=True, text=True, timeout=120,
+                          cwd=str(REPO), env=env)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_env_gate_installs_and_wraps():
+    env = dict(os.environ, QUIVER_SANITIZE="1", JAX_PLATFORMS="cpu")
+    code = (
+        "import threading\n"
+        "import quiver_tpu\n"
+        "from quiver_tpu.analysis import witness\n"
+        "assert witness.installed()\n"
+        "assert isinstance(threading.Lock(), witness._WitnessLock)\n"
+    )
+    proc = subprocess.run([sys.executable, "-c", code],
+                          capture_output=True, text=True, timeout=120,
+                          cwd=str(REPO), env=env)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_executor_first_import_under_witness():
+    # concurrent.futures.thread creates a module-level Lock at first
+    # import and registers its _at_fork_reinit with os.register_at_fork;
+    # a wrapper missing that attribute poisons the half-initialized
+    # stdlib module for the rest of the process.  Fresh interpreter so
+    # the first import really happens under the patched factory.
+    env = dict(os.environ, QUIVER_SANITIZE="1", JAX_PLATFORMS="cpu")
+    code = (
+        "import quiver_tpu\n"
+        "from concurrent.futures import ThreadPoolExecutor\n"
+        "with ThreadPoolExecutor(1) as p:\n"
+        "    assert p.submit(lambda: 41 + 1).result(10) == 42\n"
+    )
+    proc = subprocess.run([sys.executable, "-c", code],
+                          capture_output=True, text=True, timeout=120,
+                          cwd=str(REPO), env=env)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
