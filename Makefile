@@ -1,6 +1,6 @@
 # Top-level convenience targets (parity: reference ./configure && make).
 .PHONY: all native test test-quick test-native asan bench smoke \
-	telemetry-check chaos stream lint sanitize help
+	telemetry-check chaos stream lint sanitize recovery crash help
 
 all: native
 
@@ -49,5 +49,15 @@ sanitize:
 	QUIVER_SANITIZE=1 python -m pytest tests/ -m "not slow" -q
 	QUIVER_SANITIZE=1 python -m pytest tests/ -m chaos -q
 
+# WAL / checkpoint / program-registry durability suite (docs/RECOVERY.md)
+recovery:
+	python -m pytest tests/ -m recovery -q
+
+# kill -9 crash harness: real child processes SIGKILLed mid-ingest under
+# a seeded chaos plan, then recovered — zero acked loss, monotone
+# version, bit-identical sampling (docs/RECOVERY.md)
+crash:
+	python -m pytest tests/ -m crash -q
+
 help:
-	@echo "targets: native | test | test-quick | test-native | asan | bench | smoke | telemetry-check | chaos | stream | lint | sanitize"
+	@echo "targets: native | test | test-quick | test-native | asan | bench | smoke | telemetry-check | chaos | stream | lint | sanitize | recovery | crash"

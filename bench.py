@@ -1230,6 +1230,136 @@ def bench_stream_ingest(topo, batch=1024, fanout=FANOUT, iters=20,
     return st
 
 
+def bench_restart_warm(n_nodes=200_000, n_records=200, batch=1024,
+                       warm_child=True):
+    """Crash-safe durability tier (docs/RECOVERY.md): what a restart
+    actually costs.
+
+    Three numbers, measured end to end:
+
+      * **replay throughput** — ``n_records`` WAL records of ``batch``
+        edges appended (fsync=batch) then folded into a fresh graph by
+        ``RecoveryManager.finish_boot``; reported as edges/s plus the
+        append-side edges/s for contrast;
+      * **recovery-to-serving latency** — ``boot_seconds`` from the
+        manager's health doc for that same boot (checkpoint load +
+        replay + state-ladder overhead);
+      * **cold vs warm boot wall time** — two child processes boot the
+        same durability root sharing a JAX persistent compilation
+        cache; the warm child must hit the disk cache (reported) and
+        its boot-to-serving wall time shows the compile time a restart
+        no longer pays.
+    """
+    import json as _json
+    import subprocess
+    import tempfile
+
+    import numpy as _np
+
+    from quiver_tpu.recovery.manager import RecoveryManager, set_active
+    from quiver_tpu.recovery.wal import WriteAheadLog, encode_edge_op
+
+    out = dict(n_nodes=n_nodes, n_records=n_records, batch=batch)
+    rng = _np.random.default_rng(11)
+    with tempfile.TemporaryDirectory(prefix="quiver-restart-") as td:
+        root = os.path.join(td, "root")
+        wal = WriteAheadLog(os.path.join(root, "wal"), fsync="batch")
+        t0 = time.perf_counter()
+        for _ in range(n_records):
+            src = rng.integers(0, n_nodes, batch)
+            dst = rng.integers(0, n_nodes, batch)
+            wal.append(encode_edge_op("add", src, dst))
+        wal.sync()
+        append_s = time.perf_counter() - t0
+        wal.close()
+        n_edges = n_records * batch
+        out["append_edges_per_s"] = round(n_edges / max(append_s, 1e-9))
+
+        def factory():
+            from quiver_tpu import CSRTopo
+            from quiver_tpu.stream import StreamingGraph
+
+            src = _np.arange(n_nodes, dtype=_np.int64)
+            dst = (src + 1) % n_nodes
+            return StreamingGraph(CSRTopo(edge_index=_np.stack([src, dst])),
+                                  delta_capacity=n_edges + 1024)
+
+        mgr = RecoveryManager(root, graph_factory=factory)
+        mgr.boot_degraded()
+        t0 = time.perf_counter()
+        replayed = mgr.finish_boot()
+        replay_s = time.perf_counter() - t0
+        health = mgr.health()
+        mgr.close()
+        set_active(None)
+        out["replayed_records"] = replayed
+        out["replay_edges_per_s"] = round(
+            replayed * batch / max(replay_s, 1e-9))
+        out["recovery_to_serving_s"] = round(
+            health.get("boot_seconds", replay_s), 3)
+        log(f"restart_warm: replayed {replayed} records "
+            f"({out['replay_edges_per_s']:,} edges/s), boot→serving "
+            f"{out['recovery_to_serving_s']}s")
+
+        if warm_child:
+            cache_dir = os.path.join(td, "pcache")
+            os.makedirs(cache_dir, exist_ok=True)
+            child = (
+                "import json,sys,time\n"
+                "import numpy as np\n"
+                "import quiver_tpu.config as config_mod\n"
+                "root, cache_dir = sys.argv[1], sys.argv[2]\n"
+                "config_mod.update(recovery_cache_dir=cache_dir)\n"
+                "from quiver_tpu import GraphSageSampler\n"
+                "from quiver_tpu.recovery.manager import RecoveryManager\n"
+                "from quiver_tpu.recovery.registry import "
+                "get_program_registry\n"
+                "from quiver_tpu.stream import StreamingGraph\n"
+                "from quiver_tpu.utils.rng import make_key\n"
+                "from quiver_tpu.utils.topology import CSRTopo\n"
+                "def factory():\n"
+                "    src = np.arange(65536, dtype=np.int64)\n"
+                "    dst = (src + 1) % 65536\n"
+                "    return StreamingGraph(\n"
+                "        CSRTopo(edge_index=np.stack([src, dst])),\n"
+                "        delta_capacity=1024)\n"
+                "def warmup(graph):\n"
+                "    s = GraphSageSampler(graph, sizes=[10, 5],\n"
+                "                         dedup='none')\n"
+                "    s.sample(np.arange(256), key=make_key(0))\n"
+                "t0 = time.perf_counter()\n"
+                "mgr = RecoveryManager(root, graph_factory=factory)\n"
+                "g = mgr.boot(warmup=warmup)\n"
+                "wall = time.perf_counter() - t0\n"
+                "print(json.dumps({'boot_wall_s': round(wall, 3),\n"
+                "    'pcache_hits': "
+                "get_program_registry().persistent_cache_hits}))\n"
+                "mgr.close()\n"
+            )
+            boots = []
+            for tag in ("cold", "warm"):
+                proc = subprocess.run(
+                    [sys.executable, "-c", child,
+                     os.path.join(td, "warmroot"), cache_dir],
+                    capture_output=True, text=True, timeout=600,
+                    cwd=os.path.dirname(os.path.abspath(__file__)))
+                if proc.returncode != 0:
+                    log(f"restart_warm[{tag}]: child failed: "
+                        f"{proc.stderr[-500:]}")
+                    out[f"{tag}_boot"] = None
+                    continue
+                doc = _json.loads(proc.stdout.strip().splitlines()[-1])
+                boots.append(doc)
+                out[f"{tag}_boot"] = doc
+                log(f"restart_warm[{tag}]: boot {doc['boot_wall_s']}s, "
+                    f"pcache hits {doc['pcache_hits']}")
+            if len(boots) == 2 and boots[1]["pcache_hits"] > 0:
+                out["warm_speedup"] = round(
+                    boots[0]["boot_wall_s"]
+                    / max(boots[1]["boot_wall_s"], 1e-9), 2)
+    return out
+
+
 # ---------------------------------------------------------------- main
 def main():
     ap = argparse.ArgumentParser()
@@ -1239,7 +1369,8 @@ def main():
     ap.add_argument("--sections",
                     default="sampling,feature,feature_coldcache,e2e,"
                             "serving,serving_flightrec,"
-                            "serving_resilience,stream_ingest,quality",
+                            "serving_resilience,stream_ingest,"
+                            "restart_warm,quality",
                     help="comma-separated subset to run")
     ap.add_argument("--ab-dedup", action="store_true",
                     help="also measure dedup='hop' for sampling + e2e")
@@ -1427,6 +1558,11 @@ def main():
         runner.run("stream_ingest", 900,
                    lambda: bench_stream_ingest(
                        topo, batches[0], FANOUT, args.iters, gm_default))
+    if "restart_warm" in want:
+        runner.run("restart_warm", 900,
+                   lambda: bench_restart_warm(
+                       n_nodes=50_000 if args.small else 200_000,
+                       n_records=50 if args.small else 200))
 
     if "sampling" in want:
         if args.gather_mode or args.small:

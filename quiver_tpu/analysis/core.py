@@ -95,6 +95,7 @@ _DEFAULT_HOT = (
     "quiver_tpu/parallel/*.py",
     "quiver_tpu/resilience/*.py",
     "quiver_tpu/stream/*.py",
+    "quiver_tpu/recovery/*.py",
 )
 
 
@@ -112,6 +113,11 @@ class LintConfig:
     layering_exempt: Tuple[str, ...] = (
         "quiver_tpu/telemetry/export.py", "quiver_tpu/analysis/*",
     )
+    # QT011: files whose persisted bytes must flow through the blessed
+    # durable-IO helpers, and the helper module itself (the one place
+    # raw writes are allowed to live).
+    durability_scope: Tuple[str, ...] = ("quiver_tpu/recovery/*.py",)
+    durability_exempt: Tuple[str, ...] = ("quiver_tpu/recovery/blockio.py",)
     # rule codes to run; None = every registered rule
     rules: Optional[Tuple[str, ...]] = None
     exclude: Tuple[str, ...] = ("*/.*", "*/__pycache__/*")

@@ -15,13 +15,14 @@ from .qt007_silent_except import SilentExceptRule
 from .qt008_races import DataRaceRule
 from .qt009_lock_order import LockOrderRule
 from .qt010_thread_reap import ThreadReapRule
+from .qt011_durability import DurabilityRule
 
 __all__ = ["all_rules", "RULE_CLASSES"]
 
 RULE_CLASSES = (HostSyncRule, RetraceRule, LockDisciplineRule,
                 ImportLayeringRule, HygieneRule, MetricNameRule,
                 SilentExceptRule, DataRaceRule, LockOrderRule,
-                ThreadReapRule)
+                ThreadReapRule, DurabilityRule)
 
 
 def all_rules() -> List[Rule]:

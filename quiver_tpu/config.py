@@ -139,6 +139,41 @@ class Config:
     stream_ingest_priority: int = field(
         default_factory=lambda: _env("STREAM_INGEST_PRIORITY", 1, int)
     )
+    # durability / warm restart (quiver_tpu.recovery): the root the WAL
+    # and checkpoints live under ("" = volatile, no durability), the WAL
+    # fsync policy ("always" | "batch" | "off") + segment/batch sizing,
+    # checkpoint cadence and retention, the replay deadline (0 = none),
+    # the post-seal retrace budget per subsystem (-1 = count only,
+    # never raise), and the JAX persistent compilation cache directory
+    # ("" = off)
+    recovery_dir: str = field(
+        default_factory=lambda: _env("RECOVERY_DIR", "", str)
+    )
+    recovery_fsync: str = field(
+        default_factory=lambda: _env("RECOVERY_FSYNC", "always", str)
+    )
+    recovery_segment_bytes: int = field(
+        default_factory=lambda: _env("RECOVERY_SEGMENT_BYTES", 4 << 20, int)
+    )
+    recovery_batch_bytes: int = field(
+        default_factory=lambda: _env("RECOVERY_BATCH_BYTES", 1 << 16, int)
+    )
+    recovery_checkpoint_interval_s: float = field(
+        default_factory=lambda: _env("RECOVERY_CHECKPOINT_INTERVAL_S", 60.0,
+                                     float)
+    )
+    recovery_checkpoint_keep: int = field(
+        default_factory=lambda: _env("RECOVERY_CHECKPOINT_KEEP", 2, int)
+    )
+    recovery_deadline_s: float = field(
+        default_factory=lambda: _env("RECOVERY_DEADLINE_S", 0.0, float)
+    )
+    recovery_retrace_budget: int = field(
+        default_factory=lambda: _env("RECOVERY_RETRACE_BUDGET", -1, int)
+    )
+    recovery_cache_dir: str = field(
+        default_factory=lambda: _env("RECOVERY_CACHE_DIR", "", str)
+    )
     # tracing
     trace: bool = field(default_factory=lambda: _env("TRACE", False, bool))
 

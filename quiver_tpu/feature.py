@@ -141,7 +141,10 @@ class Feature:
         self.cold_cache = None          # ColdRowCache slot metadata
         self._overlay = None            # jax.Array [C, D] overlay table
         self._lazy_state = None
-        self._merge_cache = {}          # (B, bucket) -> jitted merge
+        from .recovery.registry import program_cache
+
+        self._merge_cache = program_cache(
+            "feature", owner=self)      # (B, bucket) -> jitted merge
         self._pending = {}              # prefetch staging (ids hash -> parts)
         self._stage_bufs = {}           # bucket -> reusable staging ndarray
         self._inflight = None           # deque of outstanding stage futures
@@ -396,6 +399,44 @@ class Feature:
             telemetry.counter("coldcache_invalidated_rows_total").inc(
                 dropped)
         return dropped
+
+    def export_coldcache_state(self) -> Optional[dict]:
+        """Overlay residency/frequency state for a recovery checkpoint
+        (``None`` when no overlay is attached).  Only metadata is
+        exported — the row *values* live in the host cold tier and are
+        re-gathered from it on restore."""
+        with self._plock:
+            cache = self.cold_cache
+            return cache.export_state() if cache is not None else None
+
+    def restore_coldcache_state(self, state: Optional[dict]) -> int:
+        """Re-warm the overlay from a checkpointed state.
+
+        Restores the slot metadata, then refills the device table from
+        the host cold tier for every resident slot — restoring the map
+        without the values would serve zeros for "cached" rows.  The
+        geometry must match (``ValueError`` otherwise — the caller
+        starts cold).  Returns the number of rows re-warmed.
+        """
+        import jax.numpy as jnp
+
+        if state is None:
+            return 0
+        if self.cold_cache is None:
+            self.enable_cold_cache(rows=int(state["capacity"]))
+        if self.cold_cache is None:
+            return 0  # fully hot: nothing to overlay
+        with self._plock:
+            cache = self.cold_cache
+            cache.restore_state(state)
+            slots = np.nonzero(cache.node_of >= 0)[0]
+            if slots.size:
+                rel = cache.node_of[slots]
+                rows = np.ascontiguousarray(self.cold[rel],
+                                            dtype=self._hot_dtype())
+                self._overlay = self._overlay.at[jnp.asarray(slots)].set(
+                    jnp.asarray(rows))
+        return int(slots.size)
 
     # ------------------------------------------------------------------
     def __getitem__(self, node_idx):

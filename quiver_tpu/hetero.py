@@ -152,7 +152,9 @@ class HeteroGraphSageSampler:
             self.hop_sizes = [self._norm(sizes)] * num_hops
         self.seed_type = seed_type
         self.device = device
-        self._jitted = {}
+        from .recovery.registry import program_cache
+
+        self._jitted = program_cache("hetero", owner=self)
         topo.to_device(device)
 
     def _norm(self, s) -> Dict[Relation, int]:

@@ -226,6 +226,47 @@ class ColdRowCache:
         return int(freed.size)
 
     # ------------------------------------------------------------------
+    def export_state(self) -> dict:
+        """Snapshot-able residency/frequency state (numpy copies +
+        scalars; the recovery checkpoint pins the array dtypes on
+        disk).  Caller holds the owning store's staging lock, same as
+        every other entry point."""
+        return {
+            "capacity": self.capacity, "n_rows": self.n_rows,
+            "policy": self.policy, "admit_threshold": self.admit_threshold,
+            "hand": self.hand, "next_free": self.next_free,
+            "hits": self.hits, "misses": self.misses,
+            "evictions": self.evictions,
+            "slot_of": self.slot_of.copy(), "node_of": self.node_of.copy(),
+            "freq": self.freq.copy(), "ref": self.ref.copy(),
+            "touches": self.touches.copy(),
+        }
+
+    def restore_state(self, state: dict) -> None:
+        """Adopt a previously exported state.  The geometry (capacity,
+        cold-row space) must match this instance — a warm restart with
+        a re-sized overlay starts cold instead (the caller treats the
+        ``ValueError`` as "no restore", not as a boot failure)."""
+        if int(state["capacity"]) != self.capacity:
+            raise ValueError(
+                f"overlay capacity changed: snapshot has "
+                f"{state['capacity']}, this cache has {self.capacity}")
+        if int(state["n_rows"]) != self.n_rows:
+            raise ValueError(
+                f"cold-row space changed: snapshot has {state['n_rows']} "
+                f"rows, this cache has {self.n_rows}")
+        self.slot_of = np.array(state["slot_of"], dtype=np.int32)
+        self.node_of = np.array(state["node_of"], dtype=np.int64)
+        self.freq = np.array(state["freq"], dtype=np.int64)
+        self.ref = np.array(state["ref"], dtype=np.uint8)
+        self.touches = np.array(state["touches"], dtype=np.int32)
+        self.hand = int(state["hand"])
+        self.next_free = int(state["next_free"])
+        self.hits = int(state["hits"])
+        self.misses = int(state["misses"])
+        self.evictions = int(state["evictions"])
+
+    # ------------------------------------------------------------------
     @property
     def resident(self) -> int:
         return int((self.node_of >= 0).sum())

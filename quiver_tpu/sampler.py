@@ -372,8 +372,12 @@ class GraphSageSampler:
             else [None] * len(self.sizes)
         )
         assert len(self.frontier_caps) == len(self.sizes)
-        self._jitted = {}  # batch_size -> compiled pipeline (mixed-size
-        # workloads — e.g. serving buckets — must not evict each other)
+        from .recovery.registry import program_cache
+
+        self._jitted = program_cache(
+            "sampler", owner=self)  # batch_size -> compiled pipeline
+        # (mixed-size workloads — e.g. serving buckets — must not evict
+        # each other)
         self._cpu = None
         self.uva_budget = uva_budget
         # uva_overlap=False serializes the device/host tiers (the A/B

@@ -403,7 +403,9 @@ class InferenceServer:
         self._fused = fused
         if not fused:
             self._maybe_enable_cold_cache(feature)
-        self._fused_fns = {}
+        from .recovery.registry import program_cache
+
+        self._fused_fns = program_cache("serving", owner=self)
         self._lock = threading.Lock()
         self._threads: List[threading.Thread] = []
         self._stopped = threading.Event()

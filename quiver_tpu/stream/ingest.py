@@ -22,15 +22,28 @@ path, the raised error otherwise).
 
 Chaos: ``stream.ingest`` fires inside the worker before the graph is
 touched, so injected faults produce clean ``(update, exc)`` answers.
+
+Durability (docs/RECOVERY.md): with a WAL attached
+(``RecoveryManager.attach_lane``), the worker appends each update to
+the log **before** applying it and only acks after both — an acked op
+is durable, a ``WALWriteError`` is answered on ``results`` with the
+graph untouched.  The crash semantics are *at-least-once*: a durable
+record whose ack was lost to the crash replays on boot (graph
+mutations are idempotent — re-adding an edge re-adds it, which the
+consistency contract states in terms of acked ops only).
+``CheckpointBarrier`` control items ride the same lane and run on the
+writer thread between applies, which is what makes a snapshot's graph
+state and WAL watermark agree exactly.
 """
 
 from __future__ import annotations
 
+import logging
 import queue
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Optional
+from typing import Callable, Optional
 
 from .. import telemetry
 from ..resilience import chaos
@@ -39,7 +52,9 @@ from ..resilience.lanes import BoundedLane
 from ..telemetry import flightrec
 from .compactor import compact
 
-__all__ = ["EdgeUpdate", "IngestLane"]
+__all__ = ["EdgeUpdate", "IngestLane", "CheckpointBarrier"]
+
+log = logging.getLogger("quiver_tpu.stream")
 
 _CHAOS_INGEST = chaos.point("stream.ingest")
 
@@ -63,6 +78,23 @@ class EdgeUpdate:
     meta: dict = field(default_factory=dict)
 
 
+@dataclass
+class CheckpointBarrier:
+    """A control item the writer thread executes between applies.
+
+    Deliberately carries **no** ``t_enqueue``: ``BoundedLane`` admits
+    attribute-less items as control traffic (never shed, never counted
+    against depth priorities), so a checkpoint request cannot be load-
+    shed into never happening.  The worker calls ``fn(applied_lsn)``
+    and publishes the outcome through ``done``/``result``/``error``.
+    """
+
+    fn: Callable[[int], object]
+    done: threading.Event = field(default_factory=threading.Event)
+    result: object = None
+    error: Optional[BaseException] = None
+
+
 class IngestLane:
     """Bounded edge-update lane + single writer thread.
 
@@ -76,11 +108,17 @@ class IngestLane:
     def __init__(self, graph: "StreamingGraph", depth: Optional[int] = None,
                  deadline_ms: Optional[float] = None,
                  priority: Optional[int] = None,
-                 result_queue=None, compact_on_full: bool = True):
+                 result_queue=None, compact_on_full: bool = True,
+                 wal=None):
         from ..config import get_config
 
         cfg = get_config()
         self.graph = graph
+        self.wal = wal                  # WriteAheadLog, or None = volatile
+        self.checkpoint_fn = None       # set by RecoveryManager.attach_lane
+        # writer-thread-private (worker + its barriers only — no lock):
+        self._applied_lsn = -1          # newest WAL record folded into graph
+        self._compacted = False         # inline compaction since last ckpt
         self.deadline_ms = float(
             deadline_ms if deadline_ms is not None
             else cfg.stream_ingest_deadline_ms)
@@ -132,22 +170,51 @@ class IngestLane:
                 if not self.compact_on_full:
                     raise
                 compact(self.graph)  # backpressure: fold, then retry
+                self._compacted = True
                 return self.graph.add_edges(upd.src, upd.dst, upd.ts)
         if upd.op == "remove":
             return self.graph.remove_edges(upd.src, upd.dst)
         raise ValueError(f"unknown edge op {upd.op!r}")
+
+    def _durable(self, upd: EdgeUpdate):
+        """Append ``upd`` to the WAL (durable per its fsync policy);
+        returns the LSN, or None when running volatile.  Raises
+        :class:`~quiver_tpu.recovery.errors.WALWriteError` — answered
+        on ``results`` like any other failure — when durability cannot
+        be promised; the graph is then never touched."""
+        if self.wal is None:
+            return None
+        from ..recovery.wal import encode_edge_op
+
+        return self.wal.append(
+            encode_edge_op(upd.op, upd.src, upd.dst, upd.ts))
+
+    def _run_barrier(self, item: CheckpointBarrier) -> None:
+        try:
+            item.result = item.fn(self._applied_lsn)
+        except Exception as e:
+            log.warning("checkpoint barrier failed: %s", e)
+            item.error = e
+        finally:
+            item.done.set()
 
     def _ingest_worker(self):
         while True:
             item = self.lane.get()
             if item is _STOP:
                 return
+            if isinstance(item, CheckpointBarrier):
+                self._run_barrier(item)
+                continue
             try:
                 if shed_if_expired(item, self.results, "stream_ingest"):
                     continue
                 with flightrec.activate(item.trace):
                     _CHAOS_INGEST()
+                    lsn = self._durable(item)
                     applied = self._apply(item)
+                if lsn is not None:
+                    self._applied_lsn = lsn
                 version = self.graph.version
                 if item.trace is not None:
                     item.trace.add("stream.applied",
@@ -168,6 +235,29 @@ class IngestLane:
                         time.perf_counter() - item.t_enqueue,
                         status="error", lane="stream_ingest")
                 self.results.put((item, e))
+            if self._compacted and self.checkpoint_fn is not None:
+                # an inline compaction folded the delta into a new base:
+                # snapshot it so the covered WAL prefix can truncate.
+                # Best-effort — a failed snapshot costs replay time only.
+                self._compacted = False
+                try:
+                    self.checkpoint_fn(self._applied_lsn)
+                except Exception as e:
+                    log.warning("post-compaction checkpoint failed: %s", e)
+
+    def request_checkpoint(self, fn=None) -> CheckpointBarrier:
+        """Enqueue a checkpoint barrier for the writer thread; returns
+        it immediately (wait on ``barrier.done``).  ``fn`` defaults to
+        the attached manager's snapshot function."""
+        fn = fn if fn is not None else self.checkpoint_fn
+        if fn is None:
+            raise ValueError("no checkpoint_fn attached to this lane")
+        barrier = CheckpointBarrier(fn=fn)
+        self.lane.put(barrier)
+        return barrier
+
+    def is_running(self) -> bool:
+        return self._thread.is_alive()
 
     def stop(self, timeout: float = 5.0) -> None:
         from ..resilience.shutdown import join_and_reap

@@ -16,8 +16,10 @@ Three views:
     surface: ``/debug/requests`` (retained-request summaries),
     ``/debug/requests/<trace_id>`` (one full event log), ``/debug/slo``
     (watchdog objective status), and ``/debug/breakers`` (per-lane
-    circuit-breaker states).  ``HEAD`` answers every route with the
-    headers its ``GET`` would carry.
+    circuit-breaker states).  ``/healthz`` reports the recovery
+    readiness ladder (200 only when ``serving``; 503 while
+    booting/replaying/warming — see docs/RECOVERY.md).  ``HEAD``
+    answers every route with the headers its ``GET`` would carry.
 """
 
 from __future__ import annotations
@@ -98,6 +100,15 @@ def to_json(snapshot: dict, indent: Optional[int] = None) -> str:
     return json.dumps(snapshot, indent=indent, sort_keys=True)
 
 
+class _ReuseAddrHTTPServer(ThreadingHTTPServer):
+    # explicit SO_REUSEADDR: restarting an exporter (or a recovered
+    # process re-binding its old port) must not fail on the previous
+    # instance's sockets lingering in TIME_WAIT.  stdlib HTTPServer
+    # happens to set this today; pin it so a restart-on-same-port is a
+    # contract (tests/test_recovery.py), not an implementation detail.
+    allow_reuse_address = True
+
+
 class MetricsServer:
     """Daemon-threaded stdlib HTTP server over a registry + tracer."""
 
@@ -113,10 +124,20 @@ class MetricsServer:
 
         class _Handler(BaseHTTPRequestHandler):
             def _payload(self):
-                """Route ``self.path`` -> ``(body, ctype)`` or ``None``
-                for a 404.  Shared by GET and HEAD so HEAD answers with
-                the exact headers a GET would carry."""
+                """Route ``self.path`` -> ``(body, ctype)`` or
+                ``(body, ctype, status)``, or ``None`` for a 404.
+                Shared by GET and HEAD so HEAD answers with the exact
+                headers a GET would carry."""
                 path = self.path
+                if path.startswith("/healthz"):
+                    from ..recovery.manager import health_status
+
+                    health = health_status()
+                    # load balancers read the status code; humans read
+                    # the body.  503 while booting/replaying/warming.
+                    status = 200 if health.get("ready") else 503
+                    return (json.dumps(health, indent=2),
+                            "application/json", status)
                 if path.startswith("/metrics.json"):
                     return (to_json(outer.registry.snapshot(), indent=2),
                             "application/json")
@@ -164,9 +185,13 @@ class MetricsServer:
                 if payload is None:
                     self.send_error(404)
                     return
-                body, ctype = payload
+                if len(payload) == 3:
+                    body, ctype, status = payload
+                else:
+                    body, ctype = payload
+                    status = 200
                 data = body.encode()
-                self.send_response(200)
+                self.send_response(status)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(data)))
                 self.end_headers()
@@ -182,7 +207,7 @@ class MetricsServer:
             def log_message(self, *a):  # silence per-request stderr spam
                 pass
 
-        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd = _ReuseAddrHTTPServer((host, port), _Handler)
         self._httpd.daemon_threads = True
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         name="quiver-metrics-http",

@@ -120,6 +120,10 @@ def test_stale_baseline_entry_fails_only_under_strict(tmp_path):
      "            q.get()\n"
      "        except Exception:\n"
      "            continue\n"),
+    ("quiver_tpu/recovery/wal.py", "QT011",
+     "\n\ndef _sneaky_sidecar(path):\n"
+     "    with open(path, \"w\") as f:\n"
+     "        f.write(\"unframed, unchecksummed\")\n"),
 ])
 def test_injected_violation_fails_cli(tmp_path, relpath, code, appended):
     root = _repo_copy_with(tmp_path, relpath, appended)

@@ -640,6 +640,74 @@ class TestBaseline:
         assert new[0].scope == "g"
 
 
+# ------------------------------------------------------------ QT011
+class TestDurability:
+    SCOPE = dict(durability_scope=("*.py",), durability_exempt=("blessed.py",))
+
+    def test_flags_write_mode_open(self, tmp_path):
+        r = run_lint(tmp_path, """
+            def persist(path, data):
+                with open(path, "w") as f:
+                    f.write(data)
+        """, **self.SCOPE)
+        assert codes(r) == ["QT011"]
+        assert "write-mode open" in r.findings[0].message
+
+    def test_flags_append_plus_and_exclusive_modes(self, tmp_path):
+        r = run_lint(tmp_path, """
+            def persist(path, data):
+                open(path, "ab").write(data)
+                open(path, "r+b").write(data)
+                open(path, mode="x").write(data)
+        """, **self.SCOPE)
+        assert codes(r) == ["QT011", "QT011", "QT011"]
+
+    def test_flags_unprovable_mode(self, tmp_path):
+        r = run_lint(tmp_path, """
+            def persist(path, data, mode):
+                with open(path, mode) as f:
+                    f.write(data)
+        """, **self.SCOPE)
+        assert codes(r) == ["QT011"]
+        assert "cannot prove" in r.findings[0].message
+
+    def test_flags_path_write_helpers(self, tmp_path):
+        r = run_lint(tmp_path, """
+            def persist(path, data):
+                path.write_text(data)
+                path.write_bytes(data.encode())
+        """, **self.SCOPE)
+        assert codes(r) == ["QT011", "QT011"]
+
+    def test_reads_are_clean(self, tmp_path):
+        r = run_lint(tmp_path, """
+            def replay(path):
+                with open(path, "rb") as f:
+                    head = f.read()
+                with open(path) as f:
+                    return head, f.read()
+        """, **self.SCOPE)
+        assert r.findings == []
+
+    def test_exempt_module_may_write(self, tmp_path):
+        r = run_lint(tmp_path, """
+            def atomic_publish(path, data):
+                with open(path, "wb") as f:
+                    f.write(data)
+        """, name="blessed.py", **self.SCOPE)
+        assert r.findings == []
+
+    def test_out_of_scope_module_unaffected(self, tmp_path):
+        # default scope is quiver_tpu/recovery/*.py; a plain module
+        # writing files is not this rule's business
+        r = run_lint(tmp_path, """
+            def dump(path, data):
+                with open(path, "w") as f:
+                    f.write(data)
+        """)
+        assert r.findings == []
+
+
 # ------------------------------------------------------------ CLI
 class TestCli:
     def test_exit_codes_and_baseline_flow(self, tmp_path, capsys):

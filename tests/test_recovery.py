@@ -1,0 +1,675 @@
+"""Durability + warm-restart tier tests (docs/RECOVERY.md).
+
+Correctness bar, in order of importance:
+
+* **Zero acked loss** — an edge op answered ``("ok", ...)`` on the
+  ingest results queue is on durable media: replaying the WAL into a
+  fresh graph reproduces every acked mutation, and sampling the
+  recovered graph is BIT-IDENTICAL to the uninterrupted one.
+* **Crash debris is data, not poison** — a torn tail ends its segment
+  and a checksum-corrupt record is skipped, each with its counter
+  ticked; neither ever crashes boot.  Version-skewed snapshots refuse
+  with a typed :class:`SnapshotFormatError`, never a stack trace from
+  half-parsed bytes.
+* **Durability faults are answered** — an injected ``recovery.fsync``
+  / ``recovery.wal_write`` fault surfaces as :class:`WALWriteError` on
+  the submitting request, with the graph untouched.
+* **Warm restarts re-earn nothing** — checkpointed coldcache residency
+  restores (values refilled from the cold tier), the program registry
+  accounts every executable, and a sealed registry turns post-warmup
+  compiles into typed budget violations.
+
+The kill-9 crash harness lives in ``test_recovery_crash.py`` (``crash``
+marker, ``make crash``).
+"""
+
+import json
+import os
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import quiver_tpu.config as config_mod
+from quiver_tpu import Feature, GraphSageSampler, telemetry
+from quiver_tpu.ops.coldcache import ColdRowCache
+from quiver_tpu.recovery import blockio
+from quiver_tpu.recovery.checkpoint import (
+    CHECKPOINT_FORMAT, load_checkpoint, read_checkpoint, restore_graph,
+    save_checkpoint)
+from quiver_tpu.recovery.errors import (
+    RecoveryDeadlineExceeded, RecoveryError, RetraceBudgetExceeded,
+    SnapshotFormatError, WALError, WALWriteError)
+from quiver_tpu.recovery.manager import (
+    RecoveryManager, health_status, set_active)
+from quiver_tpu.recovery.registry import get_program_registry
+from quiver_tpu.recovery.wal import (
+    WriteAheadLog, decode_edge_op, encode_edge_op)
+from quiver_tpu.resilience import chaos
+from quiver_tpu.stream import IngestLane, StreamingGraph
+from quiver_tpu.telemetry import metric_key
+from quiver_tpu.utils.rng import make_key
+from quiver_tpu.utils.topology import CSRTopo
+
+pytestmark = pytest.mark.recovery
+
+_CFG_KEYS = (
+    "recovery_dir", "recovery_fsync", "recovery_segment_bytes",
+    "recovery_batch_bytes", "recovery_checkpoint_interval_s",
+    "recovery_checkpoint_keep", "recovery_deadline_s",
+    "recovery_retrace_budget", "recovery_cache_dir",
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_recovery():
+    cfg = config_mod.get_config()
+    saved = {k: getattr(cfg, k) for k in _CFG_KEYS}
+    telemetry.set_enabled(True)
+    telemetry.reset()
+    yield
+    chaos.uninstall()
+    get_program_registry().unseal()
+    set_active(None)
+    config_mod.update(**saved)
+    telemetry.set_enabled(True)
+    telemetry.reset()
+
+
+def counter_value(name, **labels):
+    return telemetry.snapshot()["counters"].get(metric_key(name, labels), 0)
+
+
+def _ring_topo(n=64):
+    src = np.arange(n, dtype=np.int64)
+    dst = (src + 1) % n
+    return CSRTopo(edge_index=np.stack([src, dst]))
+
+
+def _sampler(g):
+    return GraphSageSampler(g, sizes=[5, 3], gather_mode="xla",
+                            dedup="none")
+
+
+def _assert_same_samples(ga, gb, seeds=None, rounds=3):
+    sa, sb = _sampler(ga), _sampler(gb)
+    seeds = np.arange(8) if seeds is None else seeds
+    for s in range(rounds):
+        a = sa.sample(seeds, key=make_key(s))
+        b = sb.sample(seeds, key=make_key(s))
+        np.testing.assert_array_equal(np.asarray(a.n_id),
+                                      np.asarray(b.n_id))
+        np.testing.assert_array_equal(np.asarray(a.n_id_mask),
+                                      np.asarray(b.n_id_mask))
+
+
+def _drain_ok(lane, n, timeout=10.0):
+    outs = []
+    for _ in range(n):
+        item, out = lane.results.get(timeout=timeout)
+        outs.append((item, out))
+    return outs
+
+
+# ---------------------------------------------------------------- blockio
+class TestBlockIO:
+    def test_crc32c_known_answer(self):
+        # the iSCSI check value for "123456789"
+        assert blockio.crc32c(b"123456789") == 0xE3069283
+        assert blockio.crc32c(b"") == 0
+
+    def test_crc32c_incremental(self):
+        whole = blockio.crc32c(b"hello world")
+        half = blockio.crc32c(b" world", blockio.crc32c(b"hello"))
+        assert whole == half
+
+    def test_record_round_trip(self, tmp_path):
+        p = tmp_path / "seg"
+        payloads = [b"a", b"bb" * 100, b""]
+        with open(p, "ab") as f:
+            for pl in payloads:
+                blockio.write_record(f, pl)
+        kinds_payloads = [(k, pl) for k, _off, pl in
+                          blockio.scan_records(p.read_bytes())]
+        assert kinds_payloads == [("ok", pl) for pl in payloads]
+
+    def test_torn_tail_stops_scan(self, tmp_path):
+        p = tmp_path / "seg"
+        with open(p, "ab") as f:
+            blockio.write_record(f, b"first")
+            blockio.write_record(f, b"second-record-payload")
+        data = p.read_bytes()
+        torn = data[:-5]  # crash mid-write of the second record
+        kinds = [k for k, _o, _p in blockio.scan_records(torn)]
+        assert kinds == ["ok", "torn"]
+
+    def test_corrupt_record_resyncs_when_frame_holds(self, tmp_path):
+        p = tmp_path / "seg"
+        with open(p, "ab") as f:
+            blockio.write_record(f, b"victim-payload")
+            blockio.write_record(f, b"survivor")
+        data = bytearray(p.read_bytes())
+        data[blockio.RECORD_HEADER_SIZE] ^= 0xFF  # bit rot in payload 0
+        scanned = list(blockio.scan_records(bytes(data)))
+        assert [k for k, _o, _p in scanned] == ["corrupt", "ok"]
+        assert scanned[1][2] == b"survivor"
+
+    def test_suspect_length_is_torn_not_seek(self):
+        # a corrupt record whose claimed end lands on garbage must stop
+        # the scan — trusting the length would misframe the whole log
+        hdr = struct.Struct("<2sII").pack(b"QW", 4, 0xDEADBEEF)
+        buf = hdr + b"ABCDgarbage-not-a-frame"
+        kinds = [k for k, _o, _p in blockio.scan_records(buf)]
+        assert kinds == ["torn"]
+
+    def test_atomic_publish(self, tmp_path):
+        target = tmp_path / "pub.bin"
+        blockio.atomic_publish(str(target), b"v1")
+        blockio.atomic_publish(str(target), b"v2")
+        assert target.read_bytes() == b"v2"
+        assert [n for n in os.listdir(tmp_path) if ".tmp." in n] == []
+
+
+# ---------------------------------------------------------------- WAL
+class TestWAL:
+    def test_append_replay_round_trip(self, tmp_path):
+        w = WriteAheadLog(tmp_path / "wal", fsync="always")
+        assert w.append(encode_edge_op("add", [1, 2], [3, 4])) == 0
+        assert w.append(encode_edge_op("remove", [5], [6])) == 1
+        w.close()
+        w2 = WriteAheadLog(tmp_path / "wal")
+        recs = list(w2.replay())
+        assert [lsn for lsn, _ in recs] == [0, 1]
+        op, src, dst, ts = decode_edge_op(recs[0][1])
+        assert (op, ts) == ("add", None)
+        assert src.tolist() == [1, 2] and dst.tolist() == [3, 4]
+        assert w2.next_lsn == 2  # numbering resumes from disk
+        w2.close()
+
+    def test_edge_codec_pins_timestamps_and_dtype(self):
+        payload = encode_edge_op(
+            "add", np.array([7], np.int32), np.array([9], np.int32),
+            ts=np.array([123], np.int16))
+        op, src, dst, ts = decode_edge_op(payload)
+        assert src.dtype == np.int64 and ts.dtype == np.int64
+        assert ts.tolist() == [123]
+        with pytest.raises(WALError):
+            decode_edge_op(payload[:-3])
+        with pytest.raises(WALError):
+            encode_edge_op("frobnicate", [1], [2])
+
+    def test_segment_rotation_and_truncation(self, tmp_path):
+        root = tmp_path / "wal"
+        w = WriteAheadLog(root, segment_bytes=64, fsync="off")
+        for i in range(8):
+            w.append(encode_edge_op("add", [i], [i + 1]))
+        segs = sorted(os.listdir(root))
+        assert len(segs) > 1
+        assert [lsn for lsn, _ in w.replay()] == list(range(8))
+        w.roll()  # seal the active segment so truncation may take it
+        removed = w.truncate_through(w.last_lsn)
+        assert removed >= 1
+        assert counter_value("recovery_wal_truncated_segments_total") \
+            == removed
+        # everything the watermark covers is gone; the log still opens
+        assert list(w.replay()) == []
+        w.close()
+
+    def test_torn_tail_detected_on_replay(self, tmp_path):
+        root = tmp_path / "wal"
+        w = WriteAheadLog(root, fsync="always")
+        for i in range(3):
+            w.append(encode_edge_op("add", [i], [i + 1]))
+        w.close()
+        seg = os.path.join(root, sorted(os.listdir(root))[-1])
+        with open(seg, "rb+") as f:
+            f.truncate(os.path.getsize(seg) - 4)  # kill -9 mid-write
+        w2 = WriteAheadLog(root)
+        assert [lsn for lsn, _ in w2.replay()] == [0, 1]
+        assert counter_value("recovery_wal_torn_tails_total") >= 1
+        # the torn slot is reused: the next append claims lsn 2
+        assert w2.next_lsn == 2
+        w2.close()
+
+    def test_corrupt_record_skipped_with_telemetry(self, tmp_path):
+        root = tmp_path / "wal"
+        w = WriteAheadLog(root, fsync="always")
+        for i in range(3):
+            w.append(encode_edge_op("add", [i], [i + 1]))
+        w.close()
+        seg = os.path.join(root, sorted(os.listdir(root))[0])
+        with open(seg, "rb+") as f:
+            f.seek(blockio.RECORD_HEADER_SIZE)  # first payload byte
+            b = f.read(1)
+            f.seek(blockio.RECORD_HEADER_SIZE)
+            f.write(bytes([b[0] ^ 0xFF]))
+        w2 = WriteAheadLog(root)
+        recs = list(w2.replay())
+        # record 0 is skipped but still owns its LSN slot
+        assert [lsn for lsn, _ in recs] == [1, 2]
+        assert counter_value("recovery_wal_corrupt_records_total") == 1
+        assert w2.next_lsn == 3
+        w2.close()
+
+    def test_fsync_fault_is_typed_error(self, tmp_path):
+        w = WriteAheadLog(tmp_path / "wal", fsync="always")
+        chaos.install(chaos.ChaosPlan(seed=7).fail(
+            "recovery.fsync", exc=OSError("disk gone"), times=1))
+        with pytest.raises(WALWriteError):
+            w.append(encode_edge_op("add", [1], [2]))
+        # the fault is transient; the log keeps working afterwards
+        assert isinstance(w.append(encode_edge_op("add", [1], [2])), int)
+        w.close()
+
+    def test_closed_wal_refuses_appends(self, tmp_path):
+        w = WriteAheadLog(tmp_path / "wal")
+        w.close()
+        with pytest.raises(WALWriteError):
+            w.append(b"late")
+
+
+# ---------------------------------------------------------------- snapshots
+class TestCheckpoint:
+    def _mutated_graph(self):
+        g = StreamingGraph(_ring_topo(), delta_capacity=512)
+        g.add_edges([0, 1], [5, 7])
+        g.remove_edges([2], [3])
+        return g
+
+    def test_round_trip_bit_identical_sampling(self, tmp_path):
+        g = self._mutated_graph()
+        save_checkpoint(tmp_path, g, wal_lsn=41)
+        ckpt = load_checkpoint(str(tmp_path))
+        assert ckpt.wal_lsn == 41
+        g2 = restore_graph(ckpt)
+        assert g2.version == g.version
+        _assert_same_samples(g, g2)
+
+    def test_on_disk_dtypes_are_endianness_pinned(self, tmp_path):
+        g = self._mutated_graph()
+        path = save_checkpoint(tmp_path, g, wal_lsn=0)
+        raw = open(path, "rb").read()
+        prefix = struct.Struct("<4sII")
+        magic, fmt, hdr_len = prefix.unpack_from(raw)
+        assert magic == b"QCKP" and fmt == CHECKPOINT_FORMAT
+        header = json.loads(raw[prefix.size:prefix.size + hdr_len])
+        assert header["arrays"], "empty array directory"
+        for spec in header["arrays"]:
+            # every array is explicitly little-endian on disk — a
+            # snapshot from any producer restores bit-identically
+            assert spec["dtype"].startswith("<"), spec
+        assert header["crc"] == blockio.crc32c(
+            raw[prefix.size + hdr_len:])
+
+    def test_version_skew_is_typed_refusal(self, tmp_path):
+        g = self._mutated_graph()
+        path = save_checkpoint(tmp_path, g, wal_lsn=0)
+        raw = bytearray(open(path, "rb").read())
+        struct.pack_into("<I", raw, 4, CHECKPOINT_FORMAT + 13)
+        blockio.atomic_publish(path, bytes(raw))
+        with pytest.raises(SnapshotFormatError) as ei:
+            read_checkpoint(path)
+        assert "not supported" in str(ei.value)
+
+    def test_corrupt_body_and_bad_magic_refuse(self, tmp_path):
+        g = self._mutated_graph()
+        path = save_checkpoint(tmp_path, g, wal_lsn=0)
+        raw = bytearray(open(path, "rb").read())
+        raw[-1] ^= 0xFF
+        blockio.atomic_publish(path, bytes(raw))
+        with pytest.raises(SnapshotFormatError, match="checksum"):
+            read_checkpoint(path)
+        blockio.atomic_publish(path, b"PKZZ" + bytes(raw[4:]))
+        with pytest.raises(SnapshotFormatError, match="magic"):
+            read_checkpoint(path)
+        with pytest.raises(SnapshotFormatError):
+            blockio.atomic_publish(path, b"QC")
+            read_checkpoint(path)
+
+    def test_load_falls_back_past_corrupt_newest(self, tmp_path):
+        g = self._mutated_graph()
+        good = save_checkpoint(tmp_path, g, wal_lsn=5)
+        g.add_edges([3], [9])
+        newest = save_checkpoint(tmp_path, g, wal_lsn=9)
+        assert newest != good
+        raw = bytearray(open(newest, "rb").read())
+        raw[-1] ^= 0xFF
+        blockio.atomic_publish(newest, bytes(raw))
+        ckpt = load_checkpoint(str(tmp_path))
+        assert ckpt.path == good and ckpt.wal_lsn == 5
+        assert counter_value("recovery_checkpoint_load_errors_total") == 1
+
+    def test_all_corrupt_raises_not_none(self, tmp_path):
+        g = self._mutated_graph()
+        path = save_checkpoint(tmp_path, g, wal_lsn=0)
+        blockio.atomic_publish(path, b"QCKPgarbage")
+        with pytest.raises(SnapshotFormatError):
+            load_checkpoint(str(tmp_path))
+        assert load_checkpoint(str(tmp_path / "empty")) is None
+
+    def test_retention_prunes_old_snapshots(self, tmp_path):
+        g = StreamingGraph(_ring_topo(), delta_capacity=512)
+        for i in range(4):
+            g.add_edges([i], [i + 2])
+            save_checkpoint(tmp_path, g, wal_lsn=i, keep=2)
+        files = [n for n in os.listdir(tmp_path) if n.endswith(".qgr")]
+        assert len(files) == 2
+
+
+# ---------------------------------------------------------------- coldcache
+class TestColdcacheState:
+    def test_cache_state_round_trip(self):
+        c = ColdRowCache(capacity=8, n_rows=100, admit_threshold=2)
+        ids = np.array([3, 7, 11], dtype=np.int64)
+        for _ in range(2):
+            hit, _ = c.probe(ids)
+            c.admit(ids[~hit])
+        state = c.export_state()
+        c2 = ColdRowCache(capacity=8, n_rows=100, admit_threshold=2)
+        c2.restore_state(state)
+        hit, slots = c2.probe(ids)
+        assert hit.all()
+        assert c2.resident == c.resident and c2.hand == c.hand
+
+    def test_geometry_mismatch_refuses(self):
+        c = ColdRowCache(capacity=8, n_rows=100)
+        state = c.export_state()
+        with pytest.raises(ValueError, match="capacity"):
+            ColdRowCache(capacity=4, n_rows=100).restore_state(state)
+        with pytest.raises(ValueError, match="cold-row"):
+            ColdRowCache(capacity=8, n_rows=50).restore_state(state)
+
+    def test_feature_restore_refills_overlay_values(self):
+        rng = np.random.default_rng(3)
+        feats = rng.standard_normal((64, 8)).astype(np.float32)
+        f = Feature(device_cache_size=16,
+                    cache_unit="rows").from_cpu_tensor(feats)
+        f.enable_cold_cache(rows=8, admit_threshold=1)
+        hot_ids = np.array([40, 41, 42, 43], dtype=np.int64)
+        for _ in range(3):
+            f[hot_ids]
+        state = f.export_coldcache_state()
+        assert state is not None and (state["node_of"] >= 0).any()
+
+        f2 = Feature(device_cache_size=16,
+                     cache_unit="rows").from_cpu_tensor(feats)
+        f2.enable_cold_cache(rows=8, admit_threshold=1)
+        warmed = f2.restore_coldcache_state(state)
+        assert warmed == int((state["node_of"] >= 0).sum())
+        # restored residency serves as device hits AND the values are
+        # the real rows, not zeros left over from the fresh overlay
+        before = f2.cold_cache.hits
+        out = np.asarray(f2[hot_ids])
+        np.testing.assert_allclose(out, feats[hot_ids], rtol=1e-6)
+        assert f2.cold_cache.hits > before
+
+
+# ---------------------------------------------------------------- ingest
+class TestDurableIngest:
+    def test_ack_implies_durable_and_replayable(self, tmp_path):
+        g = StreamingGraph(_ring_topo(), delta_capacity=512)
+        wal = WriteAheadLog(tmp_path / "wal", fsync="always")
+        lane = IngestLane(g, wal=wal).start()
+        n = 6
+        for i in range(n):
+            lane.submit([i], [(i + 7) % 64])
+        outs = _drain_ok(lane, n)
+        assert all(out[0] == "ok" for _, out in outs)
+        lane.stop()
+        # a fresh log handle sees every acked record without any close()
+        w2 = WriteAheadLog(tmp_path / "wal")
+        recs = list(w2.replay())
+        assert len(recs) >= n
+        g2 = StreamingGraph(_ring_topo(), delta_capacity=512)
+        for _lsn, payload in recs:
+            op, src, dst, ts = decode_edge_op(payload)
+            g2.add_edges(src, dst) if op == "add" \
+                else g2.remove_edges(src, dst)
+        _assert_same_samples(g, g2)
+        wal.close()
+        w2.close()
+
+    def test_wal_fault_answers_request_and_skips_apply(self, tmp_path):
+        g = StreamingGraph(_ring_topo(), delta_capacity=512)
+        wal = WriteAheadLog(tmp_path / "wal", fsync="always")
+        lane = IngestLane(g, wal=wal).start()
+        v0 = g.version
+        chaos.install(chaos.ChaosPlan(seed=1).fail(
+            "recovery.fsync", exc=OSError("dead disk"), times=1))
+        lane.submit([1], [2])
+        item, out = lane.results.get(timeout=10)
+        assert isinstance(out, WALWriteError)
+        assert g.version == v0  # the graph was never touched
+        # next op rides the recovered log
+        lane.submit([1], [2])
+        _item, out = lane.results.get(timeout=10)
+        assert out[0] == "ok"
+        lane.stop()
+        wal.close()
+
+    def test_volatile_lane_unchanged_without_wal(self):
+        g = StreamingGraph(_ring_topo(), delta_capacity=512)
+        lane = IngestLane(g).start()
+        lane.submit([0], [9])
+        _item, out = lane.results.get(timeout=10)
+        assert out[0] == "ok"
+        lane.stop()
+
+
+# ---------------------------------------------------------------- manager
+class TestRecoveryManager:
+    def _factory(self):
+        return lambda: StreamingGraph(_ring_topo(), delta_capacity=512)
+
+    def test_boot_cycle_and_replay_equivalence(self, tmp_path):
+        root = str(tmp_path / "r")
+        mgr = RecoveryManager(root, graph_factory=self._factory())
+        g = mgr.boot()
+        lane = IngestLane(g).start()
+        mgr.attach_lane(lane)
+        for i in range(5):
+            lane.submit([i], [(i + 3) % 64])
+        lane.submit([1], [2], op="remove")
+        _drain_ok(lane, 6)
+        lane.stop()
+        mgr.close()  # clean shutdown; crash-path covered by the harness
+
+        mgr2 = RecoveryManager(root, graph_factory=self._factory())
+        g2 = mgr2.boot()
+        assert mgr2.state == "serving"
+        assert g2.version == g.version  # monotone across the restart
+        _assert_same_samples(g, g2)
+        mgr2.close()
+
+    def test_checkpoint_barrier_truncates_replay(self, tmp_path):
+        root = str(tmp_path / "r")
+        mgr = RecoveryManager(root, graph_factory=self._factory())
+        g = mgr.boot()
+        lane = IngestLane(g).start()
+        mgr.attach_lane(lane)
+        for i in range(4):
+            lane.submit([i], [i + 9])
+        _drain_ok(lane, 4)
+        mgr.checkpoint()
+        for i in range(2):
+            lane.submit([i + 20], [i + 30])
+        _drain_ok(lane, 2)
+        lane.stop()
+        mgr.close()
+
+        mgr2 = RecoveryManager(root, graph_factory=self._factory())
+        g2 = mgr2.boot()
+        # only the post-checkpoint tail replays
+        assert mgr2.health()["replayed_records"] == 2
+        _assert_same_samples(g, g2)
+        mgr2.close()
+
+    def test_boot_survives_torn_and_corrupt_wal(self, tmp_path):
+        root = str(tmp_path / "r")
+        mgr = RecoveryManager(root, graph_factory=self._factory())
+        g = mgr.boot()
+        lane = IngestLane(g).start()
+        mgr.attach_lane(lane)
+        for i in range(4):
+            lane.submit([i], [i + 1])
+        _drain_ok(lane, 4)
+        lane.stop()
+        mgr.close()
+        wal_root = os.path.join(root, "wal")
+        seg = os.path.join(wal_root, sorted(os.listdir(wal_root))[0])
+        with open(seg, "rb+") as f:
+            f.seek(blockio.RECORD_HEADER_SIZE)
+            b = f.read(1)
+            f.seek(blockio.RECORD_HEADER_SIZE)
+            f.write(bytes([b[0] ^ 0xFF]))          # corrupt record 0
+            f.truncate(os.path.getsize(seg) - 3)   # tear the tail
+        mgr2 = RecoveryManager(root, graph_factory=self._factory())
+        g2 = mgr2.boot()  # must not crash
+        assert mgr2.state == "serving"
+        assert counter_value("recovery_wal_corrupt_records_total") == 1
+        assert counter_value("recovery_wal_torn_tails_total") == 1
+        assert g2.version == 2  # records 1..2 replayed; 0 lost, 3 torn
+        mgr2.close()
+
+    def test_replay_deadline_is_typed(self, tmp_path):
+        root = str(tmp_path / "r")
+        mgr = RecoveryManager(root, graph_factory=self._factory())
+        g = mgr.boot()
+        lane = IngestLane(g).start()
+        mgr.attach_lane(lane)
+        lane.submit([0], [1])
+        _drain_ok(lane, 1)
+        lane.stop()
+        mgr.close()
+        config_mod.update(recovery_deadline_s=1e-9)
+        mgr2 = RecoveryManager(root, graph_factory=self._factory())
+        mgr2.boot_degraded()
+        with pytest.raises(RecoveryDeadlineExceeded):
+            mgr2.finish_boot()
+        assert counter_value("recovery_deadline_exceeded_total") == 1
+        mgr2.close()
+
+    def test_health_ladder_and_staleness(self, tmp_path):
+        assert health_status() == {"state": "serving", "ready": True,
+                                   "stale": False, "managed": False}
+        mgr = RecoveryManager(str(tmp_path / "r"),
+                              graph_factory=self._factory())
+        mgr.boot_degraded()
+        h = health_status()
+        assert h["managed"] and h["state"] == "replaying"
+        assert h["stale"] and not h["ready"]
+        mgr.finish_boot()
+        h = health_status()
+        assert h["ready"] and h["state"] == "serving" and not h["stale"]
+        mgr.close()
+
+    def test_no_root_and_no_factory_refuse(self, tmp_path):
+        config_mod.update(recovery_dir="")
+        with pytest.raises(RecoveryError, match="durability root"):
+            RecoveryManager()
+        mgr = RecoveryManager(str(tmp_path / "r"))
+        with pytest.raises(RecoveryError, match="graph_factory"):
+            mgr.boot_degraded()
+
+    def test_periodic_checkpointer_reaps(self, tmp_path):
+        mgr = RecoveryManager(str(tmp_path / "r"),
+                              graph_factory=self._factory())
+        mgr.boot()
+        mgr.start_checkpointer(interval_s=0.05)
+        deadline = time.time() + 5
+        ckpt_dir = os.path.join(str(tmp_path / "r"), "ckpt")
+        while time.time() < deadline:
+            if os.listdir(ckpt_dir):
+                break
+            time.sleep(0.02)
+        assert os.listdir(ckpt_dir), "checkpointer never fired"
+        mgr.close()  # joins the thread via join_and_reap
+
+
+# ---------------------------------------------------------------- registry
+class TestProgramRegistry:
+    def test_counts_hits_misses_builds(self):
+        reg = get_program_registry()
+        c = reg.cache("t_unit")
+        assert c.get("k") is None
+        c["k"] = "prog"
+        assert "k" in c and c["k"] == "prog"
+        st = reg.stats()["t_unit"]
+        assert st["builds"] == 1 and st["hits"] >= 2 and st["misses"] == 1
+        assert counter_value("registry_builds_total", subsystem="t_unit") \
+            == 1
+        assert reg.export_metrics()["t_unit"]["size"] == 1
+
+    def test_setdefault_builds_once(self):
+        reg = get_program_registry()
+        c = reg.cache("t_setdefault")
+        assert c.setdefault("b", 1) == 1
+        assert c.setdefault("b", 2) == 1
+        assert reg.stats()["t_setdefault"]["builds"] == 1
+
+    def test_seal_budget_gates_late_builds(self):
+        reg = get_program_registry()
+        c = reg.cache("t_seal")
+        c["warm"] = 1
+        reg.seal(budget=1)
+        c["one-late-build-allowed"] = 2
+        with pytest.raises(RetraceBudgetExceeded):
+            c["second-late-build"] = 3
+        assert counter_value("registry_retraces_post_seal_total",
+                             subsystem="t_seal") == 2
+        reg.unseal()
+        c["fine-again"] = 4
+
+    def test_sampler_caches_are_registered(self):
+        g = StreamingGraph(_ring_topo(), delta_capacity=512)
+        s = _sampler(g)
+        s.sample(np.arange(4), key=make_key(0))
+        s.sample(np.arange(4), key=make_key(1))
+        st = get_program_registry().stats()["sampler"]
+        assert st["builds"] >= 1 and st["hits"] >= 1
+
+
+# ---------------------------------------------------------------- serving
+class TestMetricsEndpoint:
+    def test_server_restarts_twice_on_same_port(self):
+        from quiver_tpu.telemetry.export import start_http_server
+
+        srv = start_http_server()
+        port = srv.port
+        srv.close()
+        for _ in range(2):  # the regression: rebind the exact port
+            srv = start_http_server(port=port)
+            assert srv.port == port
+            srv.close()
+
+    def test_healthz_503_while_replaying_200_serving(self, tmp_path):
+        from urllib.error import HTTPError
+        from urllib.request import urlopen
+
+        from quiver_tpu.telemetry.export import start_http_server
+
+        srv = start_http_server()
+        try:
+            # unmanaged process: healthy by definition
+            doc = json.loads(urlopen(f"{srv.url}/healthz",
+                                     timeout=5).read())
+            assert doc["ready"] and not doc["managed"]
+            mgr = RecoveryManager(
+                str(tmp_path / "r"),
+                graph_factory=lambda: StreamingGraph(
+                    _ring_topo(), delta_capacity=512))
+            mgr.boot_degraded()
+            with pytest.raises(HTTPError) as ei:
+                urlopen(f"{srv.url}/healthz", timeout=5)
+            assert ei.value.code == 503
+            body = json.loads(ei.value.read())
+            assert body["state"] == "replaying" and body["stale"]
+            mgr.finish_boot()
+            doc = json.loads(urlopen(f"{srv.url}/healthz",
+                                     timeout=5).read())
+            assert doc["ready"] and doc["state"] == "serving"
+            mgr.close()
+        finally:
+            srv.close()
