@@ -14,6 +14,10 @@ lint failure.  The two blessed write paths are:
     fsyncs the directory: readers observe either the old file or the
     new one, never a half-written hybrid.
 
+``truncate_at`` rounds out the set: the only sanctioned way to shorten
+a file, used by the WAL to clear torn crash debris off a segment tail
+before new records may land behind it.
+
 The checksum is CRC-32C (Castagnoli, the iSCSI/ext4 polynomial) —
 table-driven pure Python, no third-party wheel.  Records here are edge
 batches of a few KB, where the table walk is noise next to the fsync.
@@ -28,7 +32,7 @@ from typing import Iterator, Optional, Tuple
 __all__ = [
     "crc32c", "RECORD_MAGIC", "RECORD_HEADER_SIZE", "MAX_RECORD_BYTES",
     "write_record", "scan_records", "atomic_publish", "fsync_dir",
-    "append_open",
+    "append_open", "truncate_at",
 ]
 
 # -- CRC-32C (Castagnoli) ---------------------------------------------------
@@ -72,15 +76,24 @@ def write_record(f, payload: bytes) -> int:
     """Append one framed record to ``f``; returns bytes written.
 
     Durability is the caller's job (the WAL owns the fsync policy) —
-    this writes into the OS page cache only.
+    this writes into the OS page cache only.  Header and payload go out
+    as ONE write so an unbuffered handle (``append_open``) makes a
+    crash between them impossible; a raw handle may still short-write,
+    so the loop retries the remainder (the tail of an interrupted loop
+    is exactly the torn frame ``scan_records`` knows how to stop at).
     """
     if len(payload) > MAX_RECORD_BYTES:
         raise ValueError(f"record payload {len(payload)} bytes exceeds "
                          f"MAX_RECORD_BYTES {MAX_RECORD_BYTES}")
     header = _HEADER.pack(RECORD_MAGIC, len(payload), crc32c(payload))
-    f.write(header)
-    f.write(payload)
-    return RECORD_HEADER_SIZE + len(payload)
+    mv = memoryview(header + payload)
+    total = len(mv)
+    while mv:
+        n = f.write(mv)
+        if n is None or n >= len(mv):  # buffered handles take it whole
+            break
+        mv = mv[n:]
+    return total
 
 
 def scan_records(buf: bytes) -> Iterator[Tuple[str, int, Optional[bytes]]]:
@@ -158,6 +171,25 @@ def atomic_publish(path: str, data: bytes) -> None:
 
 
 def append_open(path: str):
-    """Open a WAL segment for append — binary, unbuffered enough that
-    ``write_record`` + fsync is the full durability story."""
-    return open(path, "ab")
+    """Open a WAL segment for append — binary and **unbuffered**, so
+    every ``write_record`` reaches the OS page cache before it returns.
+
+    That is what makes the WAL's ``"batch"`` fsync policy honest about
+    kill -9: once the write syscall returns, the bytes belong to the
+    kernel and survive the process dying; a user-space stdio buffer
+    would silently hold acked records hostage until it happened to
+    fill."""
+    return open(path, "ab", buffering=0)
+
+
+def truncate_at(path: str, offset: int) -> None:
+    """Truncate ``path`` to ``offset`` bytes and fsync the result.
+
+    The third blessed write path (after records and atomic publish):
+    how the WAL clears torn debris off a segment tail before appending
+    behind it — a destructive-looking operation that only ever removes
+    bytes replay already refuses to cross."""
+    with open(path, "rb+") as f:
+        f.truncate(int(offset))
+        f.flush()
+        os.fsync(f.fileno())

@@ -45,7 +45,7 @@ from typing import Callable, Dict, Optional
 from .. import telemetry
 from .checkpoint import load_checkpoint, restore_graph, save_checkpoint
 from .errors import RecoveryDeadlineExceeded, RecoveryError, WALError
-from .wal import WriteAheadLog, decode_edge_op
+from .wal import WriteAheadLog, decode_abort, decode_edge_op
 
 __all__ = ["RecoveryManager", "health_status", "set_active",
            "RECOVERY_STATES"]
@@ -200,9 +200,8 @@ class RecoveryManager:
         deadline_s = float(cfg.recovery_deadline_s)
         t0 = time.perf_counter()
         replayed = skipped = 0
-        for lsn, payload in self.wal.replay():
-            if lsn <= self._replay_from:
-                continue
+
+        def _check_deadline() -> None:
             if deadline_s > 0 and (time.perf_counter()
                                    - self._boot_t0) > deadline_s:
                 telemetry.counter("recovery_deadline_exceeded_total").inc()
@@ -210,6 +209,29 @@ class RecoveryManager:
                     f"replay still running after {deadline_s:.1f}s "
                     f"({replayed} records in); raise "
                     "recovery_deadline_s or checkpoint more often")
+
+        # Two passes over the tail: an abort record lands AFTER the
+        # record it cancels, so the abort set must be complete before
+        # anything is folded in.  Buffering the tail is fine — it only
+        # spans back to the last checkpoint watermark.
+        tail = []
+        aborted = set()
+        for lsn, payload in self.wal.replay():
+            if lsn <= self._replay_from:
+                continue
+            _check_deadline()
+            target = decode_abort(payload)
+            if target is not None:
+                aborted.add(target)
+                continue
+            tail.append((lsn, payload))
+        for lsn, payload in tail:
+            _check_deadline()
+            if lsn in aborted:
+                # durable but nacked live (apply failed after the
+                # append): the rejected mutation must not resurrect
+                telemetry.counter("recovery_replay_aborted_total").inc()
+                continue
             try:
                 op, src, dst, ts = decode_edge_op(payload)
             except WALError as e:

@@ -46,10 +46,15 @@ __all__ = ["ProgramCache", "ProgramRegistry", "get_program_registry",
 class ProgramCache(dict):
     """A subsystem's executable cache: a dict that reports to the registry.
 
-    Lookups tick hit/miss, insertions tick builds and pass through the
-    seal gate.  Locking is the owner's concern exactly as before (e.g.
-    serving's double-checked ``_lock`` around ``_fused_fns``) — the
-    registry's own counters take its internal lock.
+    The *probes* — ``get`` and ``in`` — tick hit/miss; they are what
+    every owner's lookup idiom starts with (``fn = cache.get(B)`` /
+    ``if B not in cache``).  ``[]`` reads are deliberately silent:
+    they follow a probe in the same logical lookup, and ticking both
+    would count one lookup twice and skew the hit-rate dashboards.
+    Insertions tick builds and pass through the seal gate.  Locking is
+    the owner's concern exactly as before (e.g. serving's
+    double-checked ``_lock`` around ``_fused_fns``) — the registry's
+    own counters take its internal lock.
     """
 
     def __init__(self, subsystem: str, registry: "ProgramRegistry"):
@@ -65,10 +70,6 @@ class ProgramCache(dict):
         present = dict.__contains__(self, key)
         self._registry._tick(self.subsystem, present)
         return present
-
-    def __getitem__(self, key):
-        self._registry._tick(self.subsystem, dict.__contains__(self, key))
-        return dict.__getitem__(self, key)
 
     def __setitem__(self, key, value) -> None:
         fresh = not dict.__contains__(self, key)

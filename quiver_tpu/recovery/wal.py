@@ -30,7 +30,24 @@ Replay walks every segment in LSN order: verified records come back as
 ``(lsn, payload)``; checksum-corrupt records are skipped with
 ``recovery_wal_corrupt_records_total`` ticked; a torn tail stops the
 segment with ``recovery_wal_torn_tails_total`` ticked.  Neither crashes
-boot — both are the expected debris of a crash-mid-write.
+boot — both are the expected debris of a crash-mid-write.  Opening a
+log **truncates** any torn tail off the final segment first (same
+counter), so resumed appends can never land behind bytes replay would
+refuse to cross.
+
+Two caveats shape what replay may legitimately contain beyond the
+acked stream (the at-least-once side of the contract):
+
+  * an op whose *apply* failed after a successful durable append is
+    compensated with an **abort record** (:func:`encode_abort`) so
+    replay skips it — a rejected mutation must not resurrect;
+  * an op nacked because the *fsync itself* failed is in an
+    indeterminate state — the bytes may or may not have reached media,
+    and no trailing compensation can be promised on a log that just
+    refused a sync.  Such a record MAY replay.  Nacked ops therefore
+    must never be counted on in either direction; only acked ops are
+    guaranteed present and only abort-compensated ops guaranteed
+    absent.
 """
 
 from __future__ import annotations
@@ -49,7 +66,7 @@ from . import blockio
 from .errors import WALError, WALWriteError
 
 __all__ = ["WriteAheadLog", "encode_edge_op", "decode_edge_op",
-           "FSYNC_POLICIES"]
+           "encode_abort", "decode_abort", "FSYNC_POLICIES"]
 
 FSYNC_POLICIES = ("always", "batch", "off")
 
@@ -117,6 +134,33 @@ def decode_edge_op(payload: bytes):
         (to_native(ts) if ts is not None else None)
 
 
+# An abort is a compensation record: the durable record at
+# ``target_lsn`` was answered with an error live (its apply failed
+# AFTER the append), so replay must not fold it in — otherwise a
+# recovered graph would contain a mutation the serving process
+# rejected, and post-crash state would diverge from the state the
+# crash harness certifies.  Aborts share the edge-op framing (code 3,
+# one little-endian int64 "endpoint" carrying the target LSN) so an
+# older reader treats them as an unknown-op skip, never a crash.
+
+_ABORT_CODE = 3
+
+
+def encode_abort(target_lsn: int) -> bytes:
+    return (_EDGE_HEADER.pack(_ABORT_CODE, 0, 1)
+            + struct.pack("<q", int(target_lsn)))
+
+
+def decode_abort(payload: bytes) -> Optional[int]:
+    """Target LSN when ``payload`` is an abort record, else None."""
+    if len(payload) != _EDGE_HEADER.size + 8:
+        return None
+    code, _has_ts, _n = _EDGE_HEADER.unpack_from(payload)
+    if code != _ABORT_CODE:
+        return None
+    return int(struct.unpack_from("<q", payload, _EDGE_HEADER.size)[0])
+
+
 # -- the log ----------------------------------------------------------------
 
 class WriteAheadLog:
@@ -152,11 +196,16 @@ class WriteAheadLog:
         self._closed = False
         # resume LSN accounting from what is already on disk: only the
         # LAST segment needs a scan (earlier counts are implied by the
-        # next segment's start LSN)
+        # next segment's start LSN).  Torn debris is truncated off the
+        # tail HERE, before any append can reopen the segment — if the
+        # very first record tore (crash mid-first-write), the slot
+        # count is 0 and the next roll reuses the same wal-<start>.seg
+        # name; appending behind un-truncated torn bytes would strand
+        # every new record past the point replay stops at.
         segs = self._segments()
         if segs:
             start, path = segs[-1]
-            self._next_lsn = start + _count_slots(path)
+            self._next_lsn = start + _resume_segment(path)
         else:
             self._next_lsn = 0
         telemetry.gauge("recovery_wal_segments_total").set(float(len(segs)))
@@ -216,12 +265,15 @@ class WriteAheadLog:
 
     def _sync_locked(self) -> None:
         with self._lock:  # re-entrant: callers already hold it
-            _CHAOS_FSYNC()
+            # the chaos point lives inside the policy gate: "off"
+            # promises no fsync, so an injected fsync fault has nothing
+            # real to stand in for there
             if self.fsync_policy != "off":
+                _CHAOS_FSYNC()
                 self._f.flush()
                 os.fsync(self._f.fileno())
+                telemetry.counter("recovery_wal_fsyncs_total").inc()
             self._unsynced = 0
-        telemetry.counter("recovery_wal_fsyncs_total").inc()
 
     def _roll_locked(self) -> None:
         with self._lock:  # re-entrant: callers already hold it
@@ -339,14 +391,25 @@ class WriteAheadLog:
         return removed
 
 
-def _count_slots(path: str) -> int:
-    """LSN slots consumed by a segment (ok + corrupt records; a torn
-    tail ends the count) — how ``__init__`` resumes numbering."""
+def _resume_segment(path: str) -> int:
+    """LSN slots consumed by a segment (ok + corrupt records) — how
+    ``__init__`` resumes numbering.
+
+    A torn tail ends the count AND is truncated off the file (through
+    ``blockio.truncate_at``, the one sanctioned shortener), so a
+    segment the log re-appends to can never put fresh records behind
+    bytes replay refuses to cross.  The tick happens here instead of at
+    replay for a trimmed tail — the debris is gone before replay runs."""
     with open(path, "rb") as f:
         data = f.read()
     n = 0
-    for kind, _off, _payload in blockio.scan_records(data):
+    torn_at = None
+    for kind, off, _payload in blockio.scan_records(data):
         if kind == "torn":
+            torn_at = off
             break
         n += 1
+    if torn_at is not None:
+        blockio.truncate_at(path, torn_at)
+        telemetry.counter("recovery_wal_torn_tails_total").inc()
     return n

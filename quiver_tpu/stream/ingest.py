@@ -30,7 +30,12 @@ is durable, a ``WALWriteError`` is answered on ``results`` with the
 graph untouched.  The crash semantics are *at-least-once*: a durable
 record whose ack was lost to the crash replays on boot (graph
 mutations are idempotent — re-adding an edge re-adds it, which the
-consistency contract states in terms of acked ops only).
+consistency contract states in terms of acked ops only).  An op whose
+*apply* fails after the append (delta overflow with
+``compact_on_full=False``, a bad op) is nacked AND compensated with a
+WAL abort record, so replay does not resurrect the rejected mutation;
+only a nack from the fsync itself leaves the record's fate
+indeterminate (see the caveats in ``recovery/wal.py``).
 ``CheckpointBarrier`` control items ride the same lane and run on the
 writer thread between applies, which is what makes a snapshot's graph
 state and WAL watermark agree exactly.
@@ -189,6 +194,23 @@ class IngestLane:
         return self.wal.append(
             encode_edge_op(upd.op, upd.src, upd.dst, upd.ts))
 
+    def _abort_durable(self, lsn: int) -> None:
+        """Append a compensation record for a durable-but-nacked op.
+
+        Best-effort: if the log refuses even this, replay will apply
+        the rejected mutation (the at-least-once caveat documented in
+        ``recovery/wal.py``) — counted, logged, never raised, because
+        the producer is already being answered with the original
+        error."""
+        from ..recovery.wal import encode_abort
+
+        try:
+            self.wal.append(encode_abort(lsn))
+            telemetry.counter("recovery_wal_abort_records_total").inc()
+        except Exception as e:
+            telemetry.counter("recovery_wal_abort_failures_total").inc()
+            log.warning("could not abort nacked wal record %d: %s", lsn, e)
+
     def _run_barrier(self, item: CheckpointBarrier) -> None:
         try:
             item.result = item.fn(self._applied_lsn)
@@ -206,6 +228,7 @@ class IngestLane:
             if isinstance(item, CheckpointBarrier):
                 self._run_barrier(item)
                 continue
+            lsn = None  # set iff the append fully succeeded (durable)
             try:
                 if shed_if_expired(item, self.results, "stream_ingest"):
                     continue
@@ -228,6 +251,11 @@ class IngestLane:
                 # answer the producer with the exception object (chaos
                 # faults, bad ops) — an unanswered update would hang a
                 # waiting producer forever
+                if lsn is not None:
+                    # the record is already durable but its apply was
+                    # rejected: compensate, or replay would resurrect
+                    # a mutation this nack just disclaimed
+                    self._abort_durable(lsn)
                 telemetry.counter("stream_ingest_errors_total").inc()
                 if item.trace is not None:
                     flightrec.get_recorder().finish(

@@ -269,6 +269,59 @@ class TestWAL:
         with pytest.raises(WALWriteError):
             w.append(b"late")
 
+    def test_reused_segment_after_torn_first_record(self, tmp_path):
+        """Crash mid-FIRST-write: the whole segment is torn, the slot
+        count is 0, and the next roll reuses the same ``wal-<start>.seg``
+        name.  Opening the log truncates the debris first, so fresh
+        acked records never land behind bytes replay refuses to cross."""
+        root = tmp_path / "wal"
+        w = WriteAheadLog(root, fsync="always")
+        w.append(encode_edge_op("add", [1], [2]))
+        w.close()
+        seg = os.path.join(root, sorted(os.listdir(root))[-1])
+        with open(seg, "rb+") as f:
+            f.truncate(3)  # an unframeable stub: kill -9 mid-header
+        w2 = WriteAheadLog(root)
+        assert w2.next_lsn == 0  # the torn slot was never acked
+        assert counter_value("recovery_wal_torn_tails_total") == 1
+        lsns = [w2.append(encode_edge_op("add", [i], [i + 1]))
+                for i in range(3)]
+        assert lsns == [0, 1, 2]
+        # every fresh record is visible to replay — nothing stranded,
+        # no duplicate LSNs on the next boot
+        assert [lsn for lsn, _ in w2.replay()] == [0, 1, 2]
+        w2.close()
+        w3 = WriteAheadLog(root)
+        assert w3.next_lsn == 3
+        w3.close()
+
+    def test_batch_policy_reaches_page_cache_per_append(self, tmp_path):
+        """Under ``batch`` an acked record belongs to the kernel the
+        moment ``append`` returns — kill -9 may lose a user-space
+        buffer, never the page cache, so the segment read back through
+        the filesystem must already frame the record."""
+        root = tmp_path / "wal"
+        w = WriteAheadLog(root, fsync="batch", batch_bytes=1 << 20)
+        w.append(encode_edge_op("add", [1], [2]))
+        seg = os.path.join(root, sorted(os.listdir(root))[0])
+        with open(seg, "rb") as f:
+            kinds = [k for k, _o, _p in blockio.scan_records(f.read())]
+        assert kinds == ["ok"]
+        w.close()
+
+    def test_fsync_fault_ignored_under_policy_off(self, tmp_path):
+        """``off`` promises no fsync, so an injected fsync fault has
+        nothing real to stand in for — appends must keep succeeding."""
+        w = WriteAheadLog(tmp_path / "wal", fsync="off")
+        chaos.install(chaos.ChaosPlan(seed=7).fail(
+            "recovery.fsync", exc=OSError("disk gone"), times=100))
+        for i in range(3):
+            w.append(encode_edge_op("add", [i], [i + 1]))
+        w.sync()  # an explicit sync is equally a no-op under "off"
+        assert counter_value("recovery_wal_fsyncs_total") == 0
+        assert [lsn for lsn, _ in w.replay()] == [0, 1, 2]
+        w.close()
+
 
 # ---------------------------------------------------------------- snapshots
 class TestCheckpoint:
@@ -533,6 +586,37 @@ class TestRecoveryManager:
         assert g2.version == 2  # records 1..2 replayed; 0 lost, 3 torn
         mgr2.close()
 
+    def test_nacked_apply_is_aborted_not_replayed(self, tmp_path):
+        """An op durably appended but REJECTED by the graph (delta
+        overflow with compaction disabled) is nacked live and
+        compensated with a WAL abort record — replay must not
+        resurrect a mutation the serving process disclaimed."""
+        root = str(tmp_path / "r")
+        factory = lambda: StreamingGraph(  # noqa: E731
+            _ring_topo(), delta_capacity=2)
+        mgr = RecoveryManager(root, graph_factory=factory)
+        g = mgr.boot()
+        lane = IngestLane(g, compact_on_full=False).start()
+        mgr.attach_lane(lane)
+        lane.submit([1], [2])
+        lane.submit([3], [4])
+        _drain_ok(lane, 2)
+        lane.submit([5], [6])  # delta full: apply fails AFTER the append
+        _item, out = lane.results.get(timeout=10)
+        assert isinstance(out, BufferError)
+        assert counter_value("recovery_wal_abort_records_total") == 1
+        live_version = g.version
+        lane.stop()
+        mgr.close()
+
+        mgr2 = RecoveryManager(root, graph_factory=factory)
+        g2 = mgr2.boot()
+        assert counter_value("recovery_replay_aborted_total") == 1
+        # the rejected op stayed dead: recovered state == acked state
+        assert g2.version == live_version == 2
+        _assert_same_samples(g, g2)
+        mgr2.close()
+
     def test_replay_deadline_is_typed(self, tmp_path):
         root = str(tmp_path / "r")
         mgr = RecoveryManager(root, graph_factory=self._factory())
@@ -595,10 +679,17 @@ class TestProgramRegistry:
         c = reg.cache("t_unit")
         assert c.get("k") is None
         c["k"] = "prog"
+        # one logical lookup = one tick: the `in` probe counts, the
+        # `[]` read riding behind it is silent — the common
+        # probe-then-read idiom must not double-count
         assert "k" in c and c["k"] == "prog"
+        assert c.get("k") == "prog"
         st = reg.stats()["t_unit"]
-        assert st["builds"] == 1 and st["hits"] >= 2 and st["misses"] == 1
+        assert st["builds"] == 1 and st["hits"] == 2 and st["misses"] == 1
         assert counter_value("registry_builds_total", subsystem="t_unit") \
+            == 1
+        assert counter_value("registry_hits_total", subsystem="t_unit") == 2
+        assert counter_value("registry_misses_total", subsystem="t_unit") \
             == 1
         assert reg.export_metrics()["t_unit"]["size"] == 1
 
