@@ -1,6 +1,6 @@
 # Top-level convenience targets (parity: reference ./configure && make).
 .PHONY: all native test test-quick test-native asan bench smoke \
-	telemetry-check chaos stream lint sanitize recovery crash help
+	telemetry-check chaos stream lint sanitize recovery crash qos help
 
 all: native
 
@@ -59,5 +59,11 @@ recovery:
 crash:
 	python -m pytest tests/ -m crash -q
 
+# multi-tenant QoS suite + the closed-loop burst harness in smoke mode
+# (docs/RESILIENCE.md "QoS & degradation ladder")
+qos:
+	python -m pytest tests/ -m qos -q
+	python benchmarks/qos_load.py --smoke
+
 help:
-	@echo "targets: native | test | test-quick | test-native | asan | bench | smoke | telemetry-check | chaos | stream | lint | sanitize | recovery | crash"
+	@echo "targets: native | test | test-quick | test-native | asan | bench | smoke | telemetry-check | chaos | stream | lint | sanitize | recovery | crash | qos"

@@ -1162,6 +1162,89 @@ def bench_serving_resilience(topo, dim, classes, n_requests=300,
     return st
 
 
+def bench_serving_qos(n_requests=4000):
+    """Multi-tenant QoS A/B: routing-path overhead + the closed-loop
+    load harness (``benchmarks/qos_load.py``).
+
+      * **overhead** — the per-request cost of the batcher route with
+        QoS disabled (one ``is None`` attribute check — the production
+        steady state when the knob is off) vs enabled (allowlist
+        resolve + token-bucket take under the controller lock).
+      * **burst behaviour** — the seeded zipfian burst harness run QoS
+        ON vs OFF: with fair lanes + the ladder, the top class keeps
+        its goodput and sheds land on the floor class; without, sheds
+        are priority-blind and every class eats the backlog.
+    """
+    import queue as _queue
+
+    import quiver_tpu.config as config_mod
+    from quiver_tpu.resilience import qos as qos_mod
+    from quiver_tpu.resilience.qos import QoSController
+    from quiver_tpu.serving import RequestBatcher, ServingRequest
+    from benchmarks.qos_load import run_qos_load, TENANTS
+
+    cfg = config_mod.get_config()
+    saved = {k: getattr(cfg, k) for k in ("qos_enabled", "qos_tenants")}
+
+    def route_ns(qos_on):
+        config_mod.update(qos_enabled=qos_on, qos_tenants=TENANTS)
+        qos_mod.reset()
+        controller = (qos_mod.install_qos(QoSController())
+                      if qos_on else None)
+        # unbounded lanes (no result_queue): the measured path is route
+        # + admission only, not shedding
+        rb = RequestBatcher([_queue.Queue()], mode="Device", qos=controller)
+        reqs = [ServingRequest(ids=np.arange(4), client=0, seq=i,
+                               tenant="gold")
+                for i in range(n_requests)]
+        t0 = time.perf_counter()
+        for r in reqs:
+            rb._route(r)
+        dt = time.perf_counter() - t0
+        qos_mod.reset()
+        return dt / n_requests * 1e9
+
+    try:
+        off_ns = route_ns(False)
+        on_ns = route_ns(True)
+        rep_on = run_qos_load(smoke=True)
+        rep_off = run_qos_load(smoke=True, qos_enabled=False)
+    finally:
+        config_mod.update(**saved)
+        qos_mod.reset()
+
+    def burst_row(rep, tenant):
+        e = rep["tenants"].get(tenant, {}).get("burst", {})
+        offered = max(e.get("offered", 0), 1)
+        return dict(offered=e.get("offered", 0), ok=e.get("ok", 0),
+                    shed=e.get("shed", 0), rejected=e.get("rejected", 0),
+                    p99_ms=e.get("p99_ms", 0.0),
+                    loss_frac=round((e.get("shed", 0)
+                                     + e.get("rejected", 0)) / offered, 3))
+
+    st = dict(
+        route_off_ns=round(off_ns, 1), route_on_ns=round(on_ns, 1),
+        route_overhead_ns=round(on_ns - off_ns, 1),
+        qos_on={t: burst_row(rep_on, t) for t in ("gold", "silver",
+                                                  "bronze")},
+        qos_off={t: burst_row(rep_off, t) for t in ("gold", "silver",
+                                                    "bronze")},
+        peak_level=rep_on["peak_level"],
+        final_level=rep_on["final_level"],
+        ladder_reversed=bool(rep_on["final_level"] == 0
+                             and rep_on["fanout_frac"] == 1.0
+                             and not rep_on["coldcache_paused"]),
+        count=n_requests,
+    )
+    log(f"serving_qos: route {st['route_off_ns']} ns off / "
+        f"{st['route_on_ns']} ns on; burst gold loss "
+        f"{st['qos_on']['gold']['loss_frac']} (QoS) vs "
+        f"{st['qos_off']['gold']['loss_frac']} (none); "
+        f"ladder peak {st['peak_level']}, reversed="
+        f"{st['ladder_reversed']}")
+    return st
+
+
 def bench_stream_ingest(topo, batch=1024, fanout=FANOUT, iters=20,
                         gather_mode="auto"):
     """Streaming-overlay A/B: sampling latency as the delta overlay
@@ -1369,8 +1452,8 @@ def main():
     ap.add_argument("--sections",
                     default="sampling,feature,feature_coldcache,e2e,"
                             "serving,serving_flightrec,"
-                            "serving_resilience,stream_ingest,"
-                            "restart_warm,quality",
+                            "serving_resilience,serving_qos,"
+                            "stream_ingest,restart_warm,quality",
                     help="comma-separated subset to run")
     ap.add_argument("--ab-dedup", action="store_true",
                     help="also measure dedup='hop' for sampling + e2e")
@@ -1554,6 +1637,8 @@ def main():
         run_flightrec_section(gm_default)
     if "serving_resilience" in want:
         run_resilience_section(gm_default)
+    if "serving_qos" in want:
+        runner.run("serving_qos", 900, bench_serving_qos)
     if "stream_ingest" in want:
         runner.run("stream_ingest", 900,
                    lambda: bench_stream_ingest(

@@ -1,0 +1,374 @@
+"""Closed-loop multi-tenant QoS load harness (``make qos``).
+
+Drives the REAL serving pipeline — RequestBatcher admission,
+WeightedFairLane scheduling, InferenceServer coalescing + continuous
+batching, SLOWatchdog-fed degradation ladder — under a seeded,
+three-phase diurnal load:
+
+  1. ``baseline``  — every tenant offers its steady rate,
+  2. ``burst``     — a zipfian tenant mix (the floor class is the heavy
+     hitter) offers ``burst_x`` times the steady load, with scripted
+     chaos faults firing on the device lane mid-burst,
+  3. ``cool``      — back to steady rates, long enough for the ladder
+     to walk fully back to level 0.
+
+The model stage is a deterministic stub (a short busy-wait per batch),
+so the harness needs no accelerator and runs in seconds; everything
+*around* the model — queues, fair scheduling, token buckets, sheds,
+failover, the ladder — is the production code path.
+
+Closed loop: each phase ends with a barrier that waits until every
+submitted request has been ANSWERED (ok / shed / rejected / error), so
+phase accounting is exact, not sampled.
+
+Report (:func:`run_qos_load`): per-tenant, per-phase offered / ok /
+shed / rejected / error counts, p50/p99 latency, goodput; ladder
+history, peak level, and final reversal state (level, fanout fraction,
+cold-cache admission flag).  ``tests/test_qos.py`` asserts the
+acceptance criteria on exactly this dict.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import queue
+import sys
+import threading
+import time
+
+sys.path.insert(0, ".")
+
+import numpy as np
+
+# tenant spec used by the harness: gold is provisioned far above its
+# offered rate (its quota never rejects), bronze is the floor class and
+# the zipfian heavy hitter whose burst must not starve the others
+TENANTS = ("gold:rate=800,burst=200,weight=8,priority=3;"
+           "silver:rate=400,burst=100,weight=4,priority=2;"
+           "bronze:rate=200,burst=60,weight=2,priority=1;"
+           "ingest:rate=100,burst=50,weight=1,priority=0")
+
+# steady per-tenant offered rates (requests/s); the zipfian burst skews
+# toward the END of this list (bronze-heavy)
+STEADY_RPS = {"gold": 40.0, "silver": 30.0, "bronze": 30.0}
+
+
+class _StubBatch:
+    __slots__ = ("n_id", "layers")
+
+    def __init__(self, n_id):
+        self.n_id = n_id
+        self.layers = ()
+
+
+class _StubSampler:
+    """Deterministic sampler stand-in with the live-fanout knob the
+    ladder's L1 step drives (the assertion target for reversal)."""
+
+    mode = "CPU"
+
+    def __init__(self):
+        self.fanout_frac = 1.0
+
+    def set_fanout_frac(self, frac):
+        self.fanout_frac = float(frac)
+
+    def sample(self, ids):
+        return _StubBatch(np.asarray(ids))
+
+
+class _StubFeature:
+    """Row gather stand-in; node_count=0 keeps the server from trying
+    to attach a real cold cache to it."""
+
+    node_count = 0
+    cache_count = 0
+
+    def __getitem__(self, ids):
+        return np.zeros((len(ids), 4), dtype=np.float32)
+
+
+def _busy_wait(seconds: float) -> None:
+    # sleep() under-runs on some platforms for sub-ms waits; a spin
+    # keeps the simulated service time honest enough for queueing
+    end = time.perf_counter() + seconds
+    while time.perf_counter() < end:
+        pass
+
+
+def _make_apply(service_s: float):
+    def apply_fn(params, x, layers):
+        _busy_wait(service_s)
+        return np.zeros((len(x), 2), dtype=np.float32)
+
+    return apply_fn
+
+
+def _percentile(xs, p):
+    return float(np.percentile(np.asarray(xs), p)) if xs else 0.0
+
+
+def _schedule(rng, phases, steady, burst_x):
+    """Pre-generate the full arrival schedule: a time-sorted list of
+    ``(t_offset, phase, tenant, n_ids)``.  Burst arrivals follow a
+    zipfian tenant mix weighted toward the floor class."""
+    sched = []
+    t0 = 0.0
+    tenants = list(steady)
+    # zipf-ish burst weights, heaviest on the LAST (lowest) class
+    zipf = np.array([1.0 / (len(tenants) - i) for i in range(len(tenants))])
+    zipf = zipf / zipf.sum()
+    for name, dur, mult in phases:
+        for ti, tenant in enumerate(tenants):
+            rate = steady[tenant] * (mult * zipf[ti] * len(tenants)
+                                     if mult > 1 else 1.0)
+            n = int(rate * dur)
+            ts = t0 + rng.uniform(0.0, dur, size=n)
+            for t in ts:
+                sched.append((float(t), name, tenant,
+                              int(rng.integers(1, 6))))
+        t0 += dur
+    sched.sort(key=lambda e: e[0])
+    return sched
+
+
+def run_qos_load(smoke: bool = False, seed: int = 0,
+                 qos_enabled: bool = True, with_chaos: bool = True,
+                 burst_x: float = 10.0) -> dict:
+    """Run the harness and return the report dict.  Restores all
+    process-wide state (config, telemetry, qos, chaos) on exit."""
+    import quiver_tpu.config as config_mod
+    from quiver_tpu import telemetry
+    from quiver_tpu.resilience import chaos as chaos_mod
+    from quiver_tpu.resilience import qos as qos_mod
+    from quiver_tpu.resilience.qos import QoSController, serving_ladder
+    from quiver_tpu.serving import (HybridSampler, InferenceServer,
+                                    RequestBatcher, ServingRequest)
+    from quiver_tpu.ops.coldcache import ColdRowCache
+    from quiver_tpu.telemetry.slo import SLOWatchdog
+
+    cfg = config_mod.get_config()
+    keys = ("qos_enabled", "qos_tenants", "serving_deadline_ms",
+            "serving_queue_depth",
+            "qos_breach_ticks", "qos_recover_ticks", "qos_admit_window_ms")
+    saved = {k: getattr(cfg, k) for k in keys}
+    telemetry.set_enabled(True)
+    telemetry.reset()
+    qos_mod.reset()
+    config_mod.update(
+        qos_enabled=qos_enabled, qos_tenants=TENANTS,
+        serving_deadline_ms=0,      # latency is reported, not a deadline
+        serving_queue_depth=64,     # small lanes: watermark sheds engage
+        qos_breach_ticks=1, qos_recover_ticks=1,
+        qos_admit_window_ms=1.0,
+    )
+
+    rng = np.random.default_rng(seed)
+    dur = 0.5 if smoke else 2.0
+    phases = [("baseline", dur, 1.0), ("burst", dur * 1.5, burst_x),
+              ("cool", dur * 1.5, 1.0)]
+    sched = _schedule(rng, phases, STEADY_RPS, burst_x)
+
+    controller = None
+    ladder = None
+    sampler = _StubSampler()
+    cold_cache = ColdRowCache(capacity=64, n_rows=1024)
+    if qos_enabled:
+        controller = qos_mod.install_qos(QoSController())
+        ladder = serving_ladder(controller, sampler=sampler,
+                                cold_cache=cold_cache)
+    # SLO objective the burst is sized to breach: the stub service time
+    # times the burst backlog pushes p99 far over this
+    watchdog = SLOWatchdog(interval_s=3600.0, p99_ms=40.0,
+                           error_ratio=1.1, coldcache_hit_floor=0.0)
+    if ladder is not None:
+        ladder.attach(watchdog, objectives=("p99_latency",))
+
+    results: "queue.Queue" = queue.Queue()
+    stream: "queue.Queue" = queue.Queue()
+    # mode="Auto" with no neighbour_num sends everything to the device
+    # lane; the ladder's cpu_floor step then reroutes the floor class to
+    # the CPU lane, which HybridSampler + the server's cpu loop consume
+    rb = RequestBatcher([stream], mode="Auto", result_queue=results,
+                        qos=controller).start()
+    hs = HybridSampler(sampler, rb.cpu_batched_queue, num_workers=2,
+                       result_queue=results).start()
+    server = InferenceServer(
+        sampler, _StubFeature(), _make_apply(0.008), params=None,
+        device_batched_queue=rb.device_batched_queue,
+        cpu_sampled_queue=hs.sampled_queue,
+        result_queue=results, fused=False, max_coalesce=4,
+        cpu_sampler=sampler, qos=controller,
+    ).start()
+
+    # collector: every answer, tagged by the seq->(phase, tenant) map
+    meta: dict = {}
+    stats: dict = {}
+    answered = [0]
+    ans_lock = threading.Lock()
+    done = threading.Event()
+
+    def _bucket(phase, tenant):
+        return stats.setdefault((phase, tenant), {
+            "offered": 0, "ok": 0, "shed": 0, "rejected": 0,
+            "error": 0, "latencies": []})
+
+    def _collect():
+        from quiver_tpu.resilience.errors import (DeadlineExceeded,
+                                                  LoadShed, QuotaExceeded)
+
+        while not done.is_set() or answered[0] < len(meta):
+            try:
+                req, ans = results.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            phase, tenant = meta.get(req.seq, ("?", "?"))
+            b = _bucket(phase, tenant)
+            if isinstance(ans, QuotaExceeded):
+                b["rejected"] += 1
+            elif isinstance(ans, (LoadShed, DeadlineExceeded)):
+                b["shed"] += 1
+            elif isinstance(ans, Exception):
+                b["error"] += 1
+            else:
+                b["ok"] += 1
+                b["latencies"].append(time.perf_counter() - req.t_enqueue)
+            with ans_lock:
+                answered[0] += 1
+
+    collector = threading.Thread(target=_collect, daemon=True)
+    collector.start()
+
+    # SLO ticker driving the ladder (one observe per evaluation)
+    tick_stop = threading.Event()
+
+    def _ticker():
+        while not tick_stop.wait(0.15):
+            watchdog.evaluate_once()
+
+    ticker = threading.Thread(target=_ticker, daemon=True)
+    ticker.start()
+
+    peak_level = 0
+    if with_chaos and qos_enabled:
+        # scripted mid-burst faults on the device lane: 3 one-shot
+        # failures starting partway into the burst phase's traffic
+        burst_start = sum(1 for e in sched if e[1] == "baseline")
+        plan = chaos_mod.ChaosPlan(seed=seed)
+        plan.fail("serving.device_lane", times=3,
+                  after=burst_start + 20, every=15)
+        chaos_mod.install(plan)
+
+    t_start = time.perf_counter()
+    seq = 0
+    phase_end = {}
+    t_acc = 0.0
+    for name, d, _ in phases:
+        t_acc += d
+        phase_end[name] = t_acc
+    cur_phase = phases[0][0]
+    for t_off, phase, tenant, n in sched:
+        if phase != cur_phase:
+            # phase barrier: wait until everything submitted so far is
+            # answered before the next phase's clock starts (closed loop)
+            while True:
+                with ans_lock:
+                    if answered[0] >= seq:
+                        break
+                time.sleep(0.005)
+            cur_phase = phase
+        now = time.perf_counter() - t_start
+        if t_off > now:
+            time.sleep(t_off - now)
+        ids = np.asarray(rng.integers(0, 1024, size=n), dtype=np.int64)
+        meta[seq] = (phase, tenant)
+        req = ServingRequest(ids=ids, client=0, seq=seq, tenant=tenant)
+        _bucket(phase, tenant)["offered"] += 1
+        stream.put(req)
+        seq += 1
+        if ladder is not None:
+            peak_level = max(peak_level, ladder.level)
+    # final barrier, then let the ladder walk home on an idle system
+    while True:
+        with ans_lock:
+            if answered[0] >= seq:
+                break
+        time.sleep(0.005)
+    if ladder is not None:
+        deadline = time.perf_counter() + (5.0 if not smoke else 3.0)
+        while ladder.level > 0 and time.perf_counter() < deadline:
+            time.sleep(0.05)
+
+    tick_stop.set()
+    ticker.join(timeout=2.0)
+    done.set()
+    collector.join(timeout=2.0)
+    chaos_mod.uninstall()
+    rb.stop()
+    hs.stop()
+    server.stop()
+
+    report = {
+        "seed": seed, "smoke": smoke, "qos_enabled": qos_enabled,
+        "burst_x": burst_x, "requests": seq,
+        "phases": [p[0] for p in phases],
+        "tenants": {},
+        "peak_level": peak_level,
+        "final_level": ladder.level if ladder is not None else 0,
+        "fanout_frac": sampler.fanout_frac,
+        "coldcache_paused": cold_cache.admission_paused,
+        "ladder": ladder.status() if ladder is not None else None,
+    }
+    for (phase, tenant), b in sorted(stats.items()):
+        lat = b.pop("latencies")
+        entry = dict(b)
+        entry["p50_ms"] = round(_percentile(lat, 50) * 1e3, 2)
+        entry["p99_ms"] = round(_percentile(lat, 99) * 1e3, 2)
+        dur_s = phases[[p[0] for p in phases].index(phase)][1]
+        entry["goodput_rps"] = round(b["ok"] / dur_s, 1)
+        report["tenants"].setdefault(tenant, {})[phase] = entry
+
+    # restore process-wide state
+    telemetry.reset()
+    qos_mod.reset()
+    config_mod.update(**saved)
+    return report
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--burst-x", type=float, default=10.0)
+    ap.add_argument("--no-chaos", action="store_true")
+    ap.add_argument("--no-qos", action="store_true")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args()
+    rep = run_qos_load(smoke=args.smoke, seed=args.seed,
+                       qos_enabled=not args.no_qos,
+                       with_chaos=not args.no_chaos, burst_x=args.burst_x)
+    if args.json:
+        print(json.dumps(rep, indent=2))
+        return
+    print(f"qos_load: {rep['requests']} requests, burst x{rep['burst_x']}, "
+          f"peak ladder level {rep['peak_level']}, "
+          f"final level {rep['final_level']} "
+          f"(fanout {rep['fanout_frac']}, "
+          f"coldcache_paused={rep['coldcache_paused']})")
+    hdr = f"{'tenant':<8} {'phase':<9} {'offer':>6} {'ok':>6} {'shed':>5} " \
+          f"{'rej':>5} {'err':>4} {'p50ms':>7} {'p99ms':>8} {'rps':>7}"
+    print(hdr)
+    for tenant, by_phase in sorted(rep["tenants"].items()):
+        for phase in rep["phases"]:
+            e = by_phase.get(phase)
+            if e is None:
+                continue
+            print(f"{tenant:<8} {phase:<9} {e['offered']:>6} {e['ok']:>6} "
+                  f"{e['shed']:>5} {e['rejected']:>5} {e['error']:>4} "
+                  f"{e['p50_ms']:>7.1f} {e['p99_ms']:>8.1f} "
+                  f"{e['goodput_rps']:>7.1f}")
+
+
+if __name__ == "__main__":
+    main()

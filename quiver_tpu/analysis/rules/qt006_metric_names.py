@@ -11,7 +11,8 @@ every ``telemetry.counter/gauge/histogram`` call:
   * the metric name is a **literal** ``snake_case`` string (never an
     f-string, concatenation, or variable);
   * the name carries a unit suffix: ``_total`` (counts), ``_seconds``
-    (durations), ``_bytes`` (sizes), or ``_state`` (enum gauges);
+    (durations), ``_bytes`` (sizes), ``_state`` (enum gauges), or
+    ``_level`` (ordinal gauges — the QoS degradation ladder);
   * label keys are literal keyword arguments — ``**labels`` expansion
     hides the key set from static inspection and is flagged.
 
@@ -32,7 +33,7 @@ from typing import Iterator, Set
 from ..core import Finding, ModuleContext, Rule, dotted_call_name
 
 _FACTORIES = {"counter", "gauge", "histogram"}
-_UNIT_SUFFIXES = ("_total", "_seconds", "_bytes", "_state")
+_UNIT_SUFFIXES = ("_total", "_seconds", "_bytes", "_state", "_level")
 _SNAKE = re.compile(r"^[a-z][a-z0-9_]*$")
 # factory kwargs that are API options, not metric labels
 _OPTION_KWARGS = {"bounds", "help"}
@@ -114,7 +115,7 @@ class MetricNameRule(Rule):
                 self.code, name_arg,
                 f"metric name {name!r} lacks a unit suffix: counts end "
                 "in _total, durations in _seconds, sizes in _bytes, "
-                "enum gauges in _state")
+                "enum gauges in _state, ordinal gauges in _level")
         for kw in node.keywords:
             if kw.arg is None:
                 yield ctx.finding(

@@ -88,6 +88,50 @@ class Config:
     serving_breaker_probes: int = field(
         default_factory=lambda: _env("SERVING_BREAKER_PROBES", 1, int)
     )
+    # multi-tenant QoS (docs/RESILIENCE.md): disabled by default — the
+    # serving hot path then pays exactly one attribute check.  Tenant
+    # classes are declared as "name:rate=R,burst=B,weight=W,priority=P"
+    # entries joined by ";" (the class allowlist — it bounds the tenant
+    # label cardinality on serving metrics); unlabeled traffic maps to
+    # qos_default_tenant.  The admit window is how long the device loop
+    # holds an in-flight coalesced batch open for late arrivals
+    # (continuous batching); the quantum is the deficit-round-robin
+    # refill in ids-per-round per unit weight.  The ladder knobs gate
+    # the adaptive degradation ladder: consecutive breaching SLO ticks
+    # before stepping down, consecutive healthy ticks before stepping
+    # back up, and the fanout fraction applied at ladder level >= 1.
+    qos_enabled: bool = field(
+        default_factory=lambda: _env("QOS_ENABLED", False, bool)
+    )
+    qos_tenants: str = field(
+        default_factory=lambda: _env(
+            "QOS_TENANTS",
+            "gold:rate=200,burst=50,weight=8,priority=3;"
+            "silver:rate=100,burst=25,weight=4,priority=2;"
+            "bronze:rate=50,burst=15,weight=2,priority=1;"
+            "ingest:rate=100,burst=50,weight=1,priority=0")
+    )
+    qos_default_tenant: str = field(
+        default_factory=lambda: _env("QOS_DEFAULT_TENANT", "bronze")
+    )
+    qos_ingest_tenant: str = field(
+        default_factory=lambda: _env("QOS_INGEST_TENANT", "ingest")
+    )
+    qos_admit_window_ms: float = field(
+        default_factory=lambda: _env("QOS_ADMIT_WINDOW_MS", 2.0, float)
+    )
+    qos_quantum: int = field(
+        default_factory=lambda: _env("QOS_QUANTUM", 64, int)
+    )
+    qos_degrade_fanout_frac: float = field(
+        default_factory=lambda: _env("QOS_DEGRADE_FANOUT_FRAC", 0.5, float)
+    )
+    qos_breach_ticks: int = field(
+        default_factory=lambda: _env("QOS_BREACH_TICKS", 2, int)
+    )
+    qos_recover_ticks: int = field(
+        default_factory=lambda: _env("QOS_RECOVER_TICKS", 2, int)
+    )
     # flight recorder (docs/OBSERVABILITY.md): ring-buffer capacity of
     # retained request records, and the e2e latency above which an
     # otherwise-healthy request counts as "slow" and is retained

@@ -31,6 +31,7 @@ except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map  # type: ignore
 
 from ..resilience import chaos
+from ..resilience.deadline import check_ambient
 from ..resilience.errors import PeerTimeout
 
 __all__ = ["PartitionInfo", "DistFeature"]
@@ -411,6 +412,7 @@ class DistFeature:
         (cap = B, the exact worst case); check :meth:`overflow_stats` when
         running with a reduced cap — training on silently zeroed features
         is the failure mode this guards against."""
+        check_ambient("dist_feature")
         ov_patch = None
         if self.cold_cache is not None and not isinstance(ids, jax.Array):
             # host-side overlay probe needs host ids; device ids would
@@ -459,6 +461,10 @@ class DistFeature:
         this host — sitting in the cold-row overlay); everything else
         comes back zero.  ``last_degraded`` flags the result and
         ``last_degraded_mask`` says which rows are real."""
+        # the request's deadline likely burned while the peer timed out:
+        # shed HERE, before the local-rows gather, not after — the
+        # serving loop installed the batch deadline as ambient scope
+        check_ambient("dist_feature")
         from .. import telemetry
         from ..telemetry import flightrec
 

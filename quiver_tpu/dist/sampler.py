@@ -28,6 +28,7 @@ except ImportError:  # pragma: no cover
 
 from ..resilience import chaos
 from ..resilience.errors import PeerTimeout
+from ..resilience.retry import Backoff, retry_call
 from ..utils.topology import CSRTopo
 from ..ops.sample import sample_neighbors
 from ..sampler import LayerBlock, SampledBatch
@@ -160,6 +161,13 @@ class DistGraphSampler:
         self.indptr_sh = jax.device_put(ip, sh2)
         self.indices_sh = jax.device_put(ix, sh2)
         self._fn = {}
+        # retry pacing for the exchange path: short, jittered (so shards
+        # that timed out together don't re-collide), seeded off the
+        # sampler seed so runs replay byte-identically
+        import random as _random
+
+        self._retry_backoff = Backoff(0.005, cap_s=0.02, jitter=0.5,
+                                      rng=_random.Random(seed))
 
     # ------------------------------------------------------------------
     def _hop(self, k: int, cap: int):
@@ -303,25 +311,25 @@ class DistGraphSampler:
         sh = NamedSharding(self.mesh, P(self.axis, None))
         seeds = jax.device_put(seeds, sh)
         valid = jax.device_put(valid, sh)
-        try:
+        def _exchange():
             _CHAOS_EXCHANGE()
-            n_id, n_mask, num, blocks, overflow = self._fn[B](
+            return self._fn[B](
                 self.indptr_sh, self.indices_sh, seeds, valid,
                 jnp.int32(key),
             )
-        except (PeerTimeout, TimeoutError):
-            # one immediate retry — a transient peer stall usually
-            # clears; a second timeout surfaces to the caller (sampling
-            # has no partial-answer degrade: a frontier with holes would
-            # silently bias the training batch)
+
+        def _on_retry(attempt, exc):
             from .. import telemetry
 
             telemetry.counter("dist_sampler_retries_total").inc()
-            _CHAOS_EXCHANGE()
-            n_id, n_mask, num, blocks, overflow = self._fn[B](
-                self.indptr_sh, self.indices_sh, seeds, valid,
-                jnp.int32(key),
-            )
+
+        # one retried attempt with a short jittered backoff — a
+        # transient peer stall usually clears; a second timeout surfaces
+        # to the caller (sampling has no partial-answer degrade: a
+        # frontier with holes would silently bias the training batch)
+        n_id, n_mask, num, blocks, overflow = retry_call(
+            _exchange, attempts=2, backoff=self._retry_backoff,
+            retry_on=(PeerTimeout, TimeoutError), on_retry=_on_retry)
         self.last_overflow = overflow
         self._overflow_recorded = False
         return n_id, n_mask, num, blocks

@@ -89,6 +89,10 @@ class ColdRowCache:
         self.hits = 0
         self.misses = 0
         self.evictions = 0
+        # brownout switch (QoS degradation ladder L2): while True,
+        # admit() stops taking new rows — probes and hits still serve,
+        # but no slot churn / device row writes happen under overload
+        self.admission_paused = False
 
     # ------------------------------------------------------------------
     def probe(self, ids: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
@@ -125,7 +129,7 @@ class ColdRowCache:
         """
         ids = np.asarray(ids, dtype=np.int64)
         out = np.full(len(ids), -1, dtype=np.int32)
-        if not len(ids):
+        if not len(ids) or self.admission_paused:
             return out, 0
         cand = np.unique(ids[self.touches[ids] >= self.admit_threshold])
         cand = cand[: self.capacity]

@@ -18,6 +18,13 @@ prefetch, and dist (``docs/RESILIENCE.md``):
     :class:`CircuitBreaker`; in-flight requests reroute to the CPU
     sampler lane, and ``DistFeature.lookup`` degrades to locally
     resolvable rows (``degraded=True``) on a peer-shard timeout.
+  * **multi-tenant QoS + degradation ladder** (:mod:`.qos`,
+    :class:`~.lanes.WeightedFairLane`) — per-tenant token-bucket
+    admission (typed :class:`~.errors.QuotaExceeded` answers with a
+    retry-after hint), deficit-weighted round-robin fair scheduling
+    across tenant classes, and a reversible SLO-burn-driven brownout
+    ladder (``serving_degradation_level``).  Off by default
+    (``config.qos_enabled``); the hot path then pays one check.
   * **deterministic fault injection** (:mod:`.chaos`) — named
     injection points (``chaos.point("serving.device_lane")``) compile
     to one attribute read + None-check when no plan is installed, and
@@ -32,15 +39,24 @@ from __future__ import annotations
 
 from .breaker import CircuitBreaker, breakers_status, get_breaker
 from .chaos import ChaosPlan, point
-from .deadline import deadline_for, shed, shed_if_expired
+from .deadline import (check_ambient, deadline_for, deadline_scope, shed,
+                       shed_if_expired)
 from .errors import (ChaosFault, DeadlineExceeded, LaneUnavailable,
-                     LoadShed, PeerTimeout, ResilienceError)
-from .lanes import BoundedLane
+                     LoadShed, PeerTimeout, QuotaExceeded, ResilienceError)
+from .lanes import BoundedLane, WeightedFairLane
+from .qos import (DegradationLadder, LadderStep, QoSController, TenantClass,
+                  TokenBucket, get_qos, install_qos, qos_from_config,
+                  qos_status, serving_ladder)
+from .retry import Backoff, retry_call
 from .shutdown import join_and_reap
 
 __all__ = [
-    "BoundedLane", "ChaosFault", "ChaosPlan", "CircuitBreaker",
-    "DeadlineExceeded", "LaneUnavailable", "LoadShed", "PeerTimeout",
-    "ResilienceError", "breakers_status", "deadline_for", "get_breaker",
-    "join_and_reap", "point", "shed", "shed_if_expired",
+    "Backoff", "BoundedLane", "ChaosFault", "ChaosPlan", "CircuitBreaker",
+    "DeadlineExceeded", "DegradationLadder", "LadderStep", "LaneUnavailable",
+    "LoadShed", "PeerTimeout", "QoSController", "QuotaExceeded",
+    "ResilienceError", "TenantClass", "TokenBucket", "WeightedFairLane",
+    "breakers_status", "check_ambient", "deadline_for", "deadline_scope",
+    "get_breaker", "get_qos", "install_qos", "join_and_reap", "point",
+    "qos_from_config", "qos_status", "retry_call", "serving_ladder",
+    "shed", "shed_if_expired",
 ]

@@ -9,6 +9,15 @@ the failover lane instead).  After ``reset_timeout_s`` the next
 probe requests: one success closes the breaker, one failure re-opens
 it and restarts the timeout.
 
+Half-open is gated to a **single in-flight probe**: the first ``allow``
+after the timeout wins the probe slot; every concurrent caller sees the
+breaker as still open until that probe resolves (``record_success`` /
+``record_failure``).  Admitting every concurrent caller as a probe —
+the original behaviour — stampedes a barely-recovered lane with the
+exact burst that tripped it.  Consecutive probe failures also back off
+the reset timeout exponentially (:class:`~.retry.Backoff`, capped at
+8x), so a hard-down lane is probed ever more gently.
+
 State is exported two ways: the gauge ``serving_breaker_state{lane}``
 (0 closed, 1 half-open, 2 open) plus
 ``serving_breaker_transitions_total{lane, to}`` in the registry, and
@@ -45,7 +54,8 @@ class CircuitBreaker:
     CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
 
     _guarded_by = {"_failures": "_lock", "_state": "_lock",
-                   "_opened_at": "_lock", "_probes": "_lock"}
+                   "_opened_at": "_lock", "_probes": "_lock",
+                   "_probe_inflight": "_lock", "_reopens": "_lock"}
 
     def __init__(self, name: str, failure_threshold: Optional[int] = None,
                  reset_timeout_s: Optional[float] = None,
@@ -70,8 +80,23 @@ class CircuitBreaker:
         self._failures = 0
         self._opened_at = 0.0
         self._probes = 0
+        self._probe_inflight = False
+        self._reopens = 0  # consecutive failed probes: backs off the timeout
+        from .retry import Backoff
+
+        # deterministic (jitter=0) so scripted-clock tests stay exact;
+        # delay(0) == reset_timeout_s, doubling per consecutive reopen
+        self._reopen_backoff = Backoff(self.reset_timeout_s,
+                                       cap_s=self.reset_timeout_s * 8)
         telemetry.gauge("serving_breaker_state", lane=name).set(0)
         _register(self)
+
+    def _current_timeout_s(self) -> float:
+        """Caller holds ``_lock``: the open→half-open delay.  The first
+        failed probe re-opens at the base timeout; each further
+        consecutive failure doubles it (capped), so a hard-down lane is
+        probed ever more gently."""
+        return self._reopen_backoff.delay(max(self._reopens - 1, 0))
 
     # -- decisions ------------------------------------------------------
     def allow(self) -> bool:
@@ -80,26 +105,36 @@ class CircuitBreaker:
             if self._state == self.CLOSED:
                 return True
             if self._state == self.OPEN:
-                if self._clock() - self._opened_at < self.reset_timeout_s:
+                if self._clock() - self._opened_at < \
+                        self._current_timeout_s():
                     return False
                 self._transition(self.HALF_OPEN)
                 self._probes = 0
-            # half-open: admit up to half_open_probes in-flight probes
-            if self._probes < self.half_open_probes:
+                self._probe_inflight = False
+            # half-open: exactly ONE probe in flight; sequential probes
+            # up to half_open_probes, concurrent callers see open
+            if (not self._probe_inflight
+                    and self._probes < self.half_open_probes):
                 self._probes += 1
+                self._probe_inflight = True
                 return True
             return False
 
     def record_success(self) -> None:
         with self._lock:
             self._failures = 0
+            self._probe_inflight = False
+            self._reopens = 0
             if self._state == self.HALF_OPEN:
                 self._transition(self.CLOSED)
 
     def record_failure(self) -> None:
         with self._lock:
             if self._state == self.HALF_OPEN:
-                # the probe failed: back to open, restart the timeout
+                # the probe failed: back to open, restart the (now
+                # backed-off) timeout
+                self._probe_inflight = False
+                self._reopens += 1
                 self._opened_at = self._clock()
                 self._transition(self.OPEN)
                 return
@@ -143,6 +178,10 @@ class CircuitBreaker:
             if self._state != self.CLOSED:
                 st["open_age_s"] = round(
                     max(self._clock() - self._opened_at, 0.0), 3)
+                st["effective_reset_timeout_s"] = round(
+                    self._current_timeout_s(), 3)
+            if self._state == self.HALF_OPEN:
+                st["probe_inflight"] = self._probe_inflight
         return st
 
 

@@ -19,14 +19,73 @@ flows downstream to a stage that can answer it.
 
 from __future__ import annotations
 
+import contextvars
 import time
-from typing import Optional
+from typing import Optional, Tuple
 
 from .. import telemetry
 from ..telemetry import flightrec
 from .errors import DeadlineExceeded, LoadShed
 
-__all__ = ["deadline_for", "shed", "shed_if_expired"]
+__all__ = ["deadline_for", "shed", "shed_if_expired",
+           "deadline_scope", "ambient_deadline", "check_ambient"]
+
+# -- ambient deadline (contextvar) ---------------------------------------
+# Serving loops install the in-flight batch's tightest deadline here so
+# code they call into WITHOUT a request in hand — the dist feature's
+# degraded local-rows gather, most importantly — can still refuse dead
+# work.  Holds ``(deadline, t_start)`` or None; with deadlines disabled
+# nothing is ever installed and ``check_ambient`` is one contextvar read.
+_AMBIENT: "contextvars.ContextVar[Optional[Tuple[float, float]]]" = \
+    contextvars.ContextVar("quiver_ambient_deadline", default=None)
+
+
+class deadline_scope:
+    """``with deadline_scope(deadline, t_start):`` — make a deadline
+    ambient for the block.  ``deadline=None`` is a no-op scope, so call
+    sites need no branch."""
+
+    __slots__ = ("_deadline", "_t_start", "_token")
+
+    def __init__(self, deadline: Optional[float],
+                 t_start: Optional[float] = None):
+        self._deadline = deadline
+        self._t_start = t_start
+        self._token = None
+
+    def __enter__(self):
+        if self._deadline is not None:
+            t0 = self._t_start if self._t_start is not None \
+                else time.perf_counter()
+            self._token = _AMBIENT.set((self._deadline, t0))
+        return self
+
+    def __exit__(self, *exc):
+        if self._token is not None:
+            _AMBIENT.reset(self._token)
+        return False
+
+
+def ambient_deadline() -> Optional[float]:
+    """The ambient absolute deadline, or None."""
+    scope = _AMBIENT.get()
+    return scope[0] if scope is not None else None
+
+
+def check_ambient(lane: str) -> None:
+    """Raise :class:`DeadlineExceeded` iff the ambient deadline has
+    passed — the callee-side twin of :func:`shed_if_expired` for code
+    paths that hold no request object (degraded dist lookups).  One
+    contextvar read when no scope is installed."""
+    scope = _AMBIENT.get()
+    if scope is None:
+        return
+    deadline, t0 = scope
+    now = time.perf_counter()
+    if now < deadline:
+        return
+    raise DeadlineExceeded((now - t0) * 1e3, (deadline - t0) * 1e3,
+                           lane=lane)
 
 
 def deadline_for(t_enqueue: float,
@@ -44,9 +103,20 @@ def deadline_for(t_enqueue: float,
 
 def shed(req, result_queue, lane: str, reason: str) -> None:
     """Shed ``req`` unconditionally: tick the metric, retain the flight
-    record, answer on ``result_queue`` (when one is in scope)."""
+    record, answer on ``result_queue`` (when one is in scope).
+
+    The ``tenant`` label appears only on requests that passed QoS
+    admission (which stamps the resolved class — an allowlisted name,
+    so cardinality stays bounded); without QoS the metric keys are
+    byte-identical to the pre-QoS ones."""
     now = time.perf_counter()
-    telemetry.counter("serving_shed_total", reason=reason, lane=lane).inc()
+    tenant = getattr(req, "tenant_class", None)
+    if tenant is not None:
+        telemetry.counter("serving_shed_total", reason=reason, lane=lane,
+                          tenant=tenant).inc()
+    else:
+        telemetry.counter("serving_shed_total", reason=reason,
+                          lane=lane).inc()
     elapsed = max(now - req.t_enqueue, 0.0)
     if reason == "deadline":
         budget_s = (req.deadline - req.t_enqueue

@@ -13,7 +13,7 @@ from typing import Optional
 
 __all__ = [
     "ResilienceError", "DeadlineExceeded", "LoadShed", "LaneUnavailable",
-    "PeerTimeout", "ChaosFault",
+    "PeerTimeout", "ChaosFault", "QuotaExceeded",
 ]
 
 
@@ -49,6 +49,24 @@ class LoadShed(ResilienceError):
         self.lane = lane
         where = f" from lane {lane!r}" if lane else ""
         super().__init__(f"request shed{where} ({reason})")
+
+
+class QuotaExceeded(ResilienceError):
+    """The tenant's token bucket is empty — cooperative backpressure.
+
+    Unlike :class:`LoadShed` (the *system* is overloaded, back off with
+    jitter), this answer means *this tenant* exceeded its provisioned
+    rate; ``retry_after_s`` is the earliest time a retry can be
+    admitted, computed from the bucket's refill rate, so a well-behaved
+    client can pace itself instead of hammering the admission gate.
+    """
+
+    def __init__(self, tenant: str, retry_after_s: float):
+        self.tenant = tenant
+        self.retry_after_s = float(retry_after_s)
+        super().__init__(
+            f"tenant {tenant!r} over quota; retry after "
+            f"{self.retry_after_s:.3f} s")
 
 
 class LaneUnavailable(ResilienceError):

@@ -364,6 +364,12 @@ class GraphSageSampler:
             assert edge_weights is None, (
                 "StreamingGraph: uniform sampling only")
         self.sizes = list(sizes)
+        # live fanout scale (QoS degradation ladder L1).  Applies to the
+        # HOST sampling path only: device pipelines bake ``sizes`` into
+        # the jitted closure, and recompiling under overload is exactly
+        # the wrong reaction — the CPU lane is where brownout headroom
+        # is won anyway.
+        self._fanout_frac = 1.0
         self.mode = mode
         self.dedup = dedup
         self.device = device
@@ -674,6 +680,20 @@ class GraphSageSampler:
             ),
         )
 
+    def set_fanout_frac(self, frac: float) -> None:
+        """Scale the host-path fanout to ``frac`` of the configured
+        ``sizes`` (each layer floored at 1 neighbor).  ``1.0`` restores
+        full fanout.  Reversible brownout knob for the QoS ladder —
+        device executables are untouched (their sizes are compile-time
+        constants)."""
+        self._fanout_frac = float(min(max(frac, 0.0), 1.0))
+
+    def _effective_sizes(self):
+        frac = self._fanout_frac
+        if frac >= 1.0:
+            return self.sizes
+        return [max(1, int(s * frac)) for s in self.sizes]
+
     def _sample_cpu(self, input_nodes) -> SampledBatch:
         from .cpp import native
 
@@ -684,7 +704,7 @@ class GraphSageSampler:
             )
         seeds = np.asarray(input_nodes, dtype=np.int64)
         n_id, n_mask, num_nodes, blocks = self._cpu.sample_multihop(
-            seeds, self.sizes
+            seeds, self._effective_sizes()
         )
         return SampledBatch(
             n_id=jnp.asarray(n_id), n_id_mask=jnp.asarray(n_mask),

@@ -40,9 +40,11 @@ import numpy as np
 from . import telemetry
 from .resilience import chaos
 from .resilience.breaker import CircuitBreaker
-from .resilience.deadline import deadline_for, shed_if_expired
+from .resilience.deadline import deadline_for, deadline_scope, \
+    shed_if_expired
 from .resilience.errors import LaneUnavailable
-from .resilience.lanes import BoundedLane
+from .resilience.lanes import BoundedLane, WeightedFairLane
+from .resilience.qos import qos_from_config
 from .resilience.shutdown import join_and_reap
 from .telemetry import flightrec
 
@@ -80,6 +82,12 @@ class ServingRequest:
     # the batch serving this request samples a snapshot with
     # version >= graph_version (snapshots only move forward)
     graph_version: Optional[int] = None
+    # tenant label as the client sent it (None = untenanted).  QoS
+    # admission resolves it through the configured class allowlist and
+    # stamps the resolved class on ``tenant_class`` — metrics and fair
+    # scheduling only ever see allowlisted class names.
+    tenant: Optional[str] = None
+    tenant_class: Optional[str] = None
 
     def __post_init__(self):
         if self.deadline is None:
@@ -92,6 +100,8 @@ class ServingRequest:
                 self.trace.add("enqueue", {"n_ids": int(len(self.ids)),
                                            "client": self.client,
                                            "seq": self.seq})
+        if self.trace is not None and self.tenant is not None:
+            self.trace.tenant = self.tenant
 
     def expired(self, now: Optional[float] = None) -> bool:
         if self.deadline is None:
@@ -139,25 +149,43 @@ class RequestBatcher:
         and expired requests are shed at routing; without it the lanes
         stay unbounded and nothing is shed here (there would be no way
         to answer).
+      qos: a :class:`~quiver_tpu.resilience.QoSController`, or None to
+        resolve from config (``qos_enabled``).  With QoS active, every
+        request passes token-bucket admission here (over-quota tenants
+        get a typed :class:`~quiver_tpu.resilience.QuotaExceeded`
+        answer) and the bounded lanes become
+        :class:`~quiver_tpu.resilience.WeightedFairLane`s scheduling
+        tenant classes by weight.
     """
 
     def __init__(self, stream_queues: List["queue.Queue"],
                  neighbour_num: Optional[np.ndarray] = None,
                  threshold: float = 0.0, mode: str = "Auto",
-                 result_queue: Optional["queue.Queue"] = None):
+                 result_queue: Optional["queue.Queue"] = None,
+                 qos=None):
         assert mode in ("Auto", "CPU", "Device", "Preparation")
         self.stream_queues = stream_queues
         self.neighbour_num = neighbour_num
         self.threshold = threshold
         self.mode = mode
         self.result_queue = result_queue
+        self._qos = qos if qos is not None else qos_from_config()
         if result_queue is not None:
             from .config import get_config
 
             depth = get_config().serving_queue_depth
         else:
             depth = 0
-        if depth > 0:
+        if depth > 0 and self._qos is not None:
+            weights = self._qos.weights()
+            default = self._qos.default
+            self.cpu_batched_queue = WeightedFairLane(
+                "cpu", weights, default_class=default,
+                result_queue=result_queue)
+            self.device_batched_queue = WeightedFairLane(
+                "device", weights, default_class=default,
+                result_queue=result_queue)
+        elif depth > 0:
             self.cpu_batched_queue = BoundedLane(
                 "cpu", result_queue=result_queue)
             self.device_batched_queue = BoundedLane(
@@ -169,6 +197,15 @@ class RequestBatcher:
 
     def _route(self, req: ServingRequest):
         if shed_if_expired(req, self.result_queue, "batcher"):
+            return
+        q = self._qos
+        if q is not None and not q.admit(req, self.result_queue):
+            return
+        if q is not None and q.route_floor_to_cpu and self.mode == "Auto" \
+                and req.tenant_class == q.floor:
+            # degradation ladder L3: the lowest class rides the CPU
+            # lane so the device batch stays clear for paying tiers
+            self._put(self.cpu_batched_queue, req, "cpu")
             return
         if self.mode == "CPU":
             self._put(self.cpu_batched_queue, req, "cpu")
@@ -221,8 +258,12 @@ class RequestBatcher:
         ``serving_rejected_total``, retain a ``rejected`` flight record,
         and answer on the result queue when the payload got far enough
         to be answerable."""
-        telemetry.counter("serving_rejected_total").inc()
         req = item if isinstance(item, ServingRequest) else None
+        tenant = getattr(req, "tenant_class", None)
+        if tenant is not None:  # QoS-admitted: label by class (bounded)
+            telemetry.counter("serving_rejected_total", tenant=tenant).inc()
+        else:
+            telemetry.counter("serving_rejected_total").inc()
         tr = req.trace if req is not None else flightrec.new_trace()
         if tr is not None:
             tr.add("reject", {"type": type(exc).__name__,
@@ -366,7 +407,7 @@ class InferenceServer:
                  result_queue: Optional["queue.Queue"] = None,
                  max_coalesce: Optional[int] = None,
                  fused: Optional[bool] = None,
-                 cpu_sampler=None):
+                 cpu_sampler=None, qos=None):
         self.sampler = tpu_sampler
         self.feature = feature
         self.apply_fn = apply_fn
@@ -374,6 +415,18 @@ class InferenceServer:
         self.device_q = device_batched_queue
         self.cpu_q = cpu_sampled_queue
         self.result_queue = result_queue or queue.Queue()
+        # continuous batching (QoS only): after the non-blocking drain,
+        # hold the coalesced batch open for up to this long while slots
+        # remain, admitting late arrivals into the SAME device pass.
+        # Executable keying is untouched — the batch still pads to one
+        # of the pre-compiled buckets, so steady-state retraces stay 0.
+        self._qos = qos if qos is not None else qos_from_config()
+        if self._qos is not None:
+            from .config import get_config as _gc
+
+            self._admit_window_s = float(_gc().qos_admit_window_ms) / 1e3
+        else:
+            self._admit_window_s = 0.0
         # failover route for device-lane requests when the device lane
         # fails or its breaker opens: an inline sample on the CPU
         # sampler + the shared presampled forward.  None = no route
@@ -563,14 +616,33 @@ class InferenceServer:
     def _drain_coalesce(self, first: ServingRequest):
         """Pull queued requests (non-blocking) to batch one device pass —
         under load many small requests share a single bucketed forward,
-        which is where the TPU's throughput lives."""
+        which is where the TPU's throughput lives.
+
+        With QoS active the drain gains a bounded *admit window*
+        (``config.qos_admit_window_ms``): once the queue runs dry with
+        slots still free, block briefly for late arrivals instead of
+        launching a mostly-empty pass — continuous batching.  The
+        window never extends past the first member's deadline headroom,
+        and a disabled QoS pays exactly one attribute check."""
         reqs = [first]
         budget = self.BUCKETS[-1] - len(first.ids)
+        window = self._admit_window_s
+        t_close = time.perf_counter() + window if window > 0 else 0.0
         while len(reqs) < self.max_coalesce and budget > 0:
             try:
                 item = self.device_q.get_nowait()
             except queue.Empty:
-                break
+                if window <= 0:
+                    break
+                left = t_close - time.perf_counter()
+                if first.deadline is not None:
+                    left = min(left, first.deadline - time.perf_counter())
+                if left <= 0:
+                    break
+                try:
+                    item = self.device_q.get(timeout=left)
+                except queue.Empty:
+                    break
             if item is _STOP:
                 self.device_q.put(_STOP)  # re-post for the loop to see
                 break
@@ -625,8 +697,13 @@ class InferenceServer:
             # activate(None) is the shared no-op)
             act = (flightrec.activate([r.trace for r in reqs])
                    if reqs[0].trace is not None else flightrec.activate(None))
+            # ambient deadline for callees without a request in hand
+            # (dist feature degraded lookups): the batch's tightest one
+            dls = [r.deadline for r in reqs if r.deadline is not None]
+            scope = deadline_scope(min(dls) if dls else None,
+                                   min(r.t_enqueue for r in reqs))
             try:
-                with act:
+                with act, scope:
                     if flightrec.tracing():
                         flightrec.event("dequeue",
                                         {"coalesced": len(reqs)})
@@ -654,8 +731,9 @@ class InferenceServer:
                 self._failover([req], "cpu", None)
                 continue
             stages = {"sample": float(sample_dt)}
+            scope = deadline_scope(req.deadline, req.t_enqueue)
             try:
-                with flightrec.activate(req.trace):
+                with flightrec.activate(req.trace), scope:
                     _CHAOS_CPU()
                     out = self._infer_presampled(req, batch, stages)
                 br.record_success()

@@ -53,7 +53,8 @@ from typing import Callable, Optional
 from .. import telemetry
 from ..resilience import chaos
 from ..resilience.deadline import deadline_for, shed_if_expired
-from ..resilience.lanes import BoundedLane
+from ..resilience.lanes import BoundedLane, WeightedFairLane
+from ..resilience.qos import qos_from_config
 from ..telemetry import flightrec
 from .compactor import compact
 
@@ -80,6 +81,11 @@ class EdgeUpdate:
     priority: int = 0
     trace: object = None
     admitted_version: int = -1      # graph version at admission
+    # QoS class (stamped at submit when a controller is installed —
+    # ingestion traffic rides the configured ``qos_ingest_tenant``
+    # class, so a mutation burst fair-shares against queries instead
+    # of starving them)
+    tenant_class: Optional[str] = None
     meta: dict = field(default_factory=dict)
 
 
@@ -131,11 +137,18 @@ class IngestLane:
                             else cfg.stream_ingest_priority)
         self.results = (result_queue if result_queue is not None
                         else queue.Queue())
-        self.lane = BoundedLane(
-            "stream_ingest",
-            maxsize=int(depth if depth is not None
-                        else cfg.stream_ingest_depth),
-            result_queue=self.results)
+        maxsize = int(depth if depth is not None
+                      else cfg.stream_ingest_depth)
+        self._qos = qos_from_config()
+        if self._qos is not None:
+            self.lane = WeightedFairLane(
+                "stream_ingest", self._qos.weights(),
+                default_class=self._qos.ingest,
+                maxsize=maxsize, result_queue=self.results)
+        else:
+            self.lane = BoundedLane(
+                "stream_ingest", maxsize=maxsize,
+                result_queue=self.results)
         self.compact_on_full = compact_on_full
         self._thread = threading.Thread(
             target=self._ingest_worker, daemon=True,
@@ -159,6 +172,8 @@ class IngestLane:
             priority=self.priority if priority is None else int(priority),
             trace=flightrec.new_trace(),
             admitted_version=self.graph.version,
+            tenant_class=(self._qos.ingest
+                          if self._qos is not None else None),
         )
         if upd.trace is not None:
             upd.trace.add("stream.enqueue",

@@ -15,8 +15,10 @@ Three views:
     trace events, Perfetto-loadable), plus the flight-recorder debug
     surface: ``/debug/requests`` (retained-request summaries),
     ``/debug/requests/<trace_id>`` (one full event log), ``/debug/slo``
-    (watchdog objective status), and ``/debug/breakers`` (per-lane
-    circuit-breaker states).  ``/healthz`` reports the recovery
+    (watchdog objective status), ``/debug/breakers`` (per-lane
+    circuit-breaker states), and ``/debug/qos`` (tenant classes, token
+    levels, degradation-ladder level + history).  ``/healthz`` reports
+    the recovery
     readiness ladder (200 only when ``serving``; 503 while
     booting/replaying/warming — see docs/RECOVERY.md).  ``HEAD``
     answers every route with the headers its ``GET`` would carry.
@@ -173,6 +175,11 @@ class MetricsServer:
                     from ..resilience.breaker import breakers_status
 
                     return (json.dumps(breakers_status(), indent=2),
+                            "application/json")
+                if path.startswith("/debug/qos"):
+                    from ..resilience.qos import qos_status
+
+                    return (json.dumps(qos_status(), indent=2),
                             "application/json")
                 return None
 

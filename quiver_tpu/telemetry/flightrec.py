@@ -112,7 +112,7 @@ class TraceContext:
                    "flagged": "_lock"}
 
     __slots__ = ("trace_id", "t_start", "wall_start", "events", "dropped",
-                 "flagged", "graph_version", "_lock")
+                 "flagged", "graph_version", "tenant", "_lock")
 
     def __init__(self, trace_id: Optional[str] = None):
         self.trace_id = trace_id or _next_trace_id()
@@ -121,6 +121,10 @@ class TraceContext:
         self.events: List[Tuple[float, str, str, Optional[dict]]] = []
         self.dropped = 0
         self.flagged = False
+        # tenant label, stamped at admission by serving (None for
+        # untenanted traffic); set-once before the request enters the
+        # pipeline, so unguarded reads are safe like graph_version
+        self.tenant: Optional[str] = None
         # topology version at admission (None without a streaming graph);
         # immutable after construction, so unguarded reads are safe
         self.graph_version = graph_version()
@@ -163,6 +167,8 @@ class TraceContext:
         }
         if self.graph_version is not None:
             rec["graph_version"] = self.graph_version
+        if self.tenant is not None:
+            rec["tenant"] = self.tenant
         if e2e_seconds is not None:
             rec["e2e_seconds"] = float(e2e_seconds)
         if reason is not None:
@@ -360,7 +366,7 @@ class FlightRecorder:
         event log (pull ``/debug/requests/<trace_id>`` for that)."""
         out = []
         for rec in self.records():
-            out.append({
+            summary = {
                 "trace_id": rec["trace_id"],
                 "wall_start": rec["wall_start"],
                 "e2e_ms": round(rec.get("e2e_seconds", 0.0) * 1e3, 3),
@@ -368,7 +374,10 @@ class FlightRecorder:
                 "reason": rec.get("reason"),
                 "lane": rec.get("lane"),
                 "n_events": len(rec["events"]),
-            })
+            }
+            if "tenant" in rec:
+                summary["tenant"] = rec["tenant"]
+            out.append(summary)
         return out
 
     def reset(self) -> None:

@@ -73,7 +73,8 @@ def _merged_histogram(snap: dict, name: str):
 class SLOWatchdog:
     """Periodic evaluator of serving SLOs against registry deltas."""
 
-    _guarded_by = {"_state": "_lock", "_prev": "_lock", "_ticks": "_lock"}
+    _guarded_by = {"_state": "_lock", "_prev": "_lock", "_ticks": "_lock",
+                   "_listeners": "_lock"}
 
     def __init__(self, registry=None, interval_s: Optional[float] = None,
                  p99_ms: Optional[float] = None,
@@ -99,8 +100,17 @@ class SLOWatchdog:
         self._prev: Optional[dict] = None
         self._state: Dict[str, dict] = {}
         self._ticks = 0
+        self._listeners: List = []
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+
+    def add_listener(self, fn) -> None:
+        """Register ``fn(results)`` to run after every evaluation — the
+        reaction hook (the QoS degradation ladder attaches here).
+        Listener exceptions are swallowed: a broken reaction must not
+        kill SLO scoring."""
+        with self._lock:
+            self._listeners.append(fn)
 
     # -- evaluation -----------------------------------------------------
     def evaluate_once(self) -> List[dict]:
@@ -132,6 +142,14 @@ class SLOWatchdog:
                 if r["breaching"]:
                     st["breaches_total"] += 1
                 st.update(r)
+            listeners = list(self._listeners)
+        for fn in listeners:
+            try:
+                fn(results)
+            except Exception:
+                # a reaction bug must not kill the scoring loop — it is
+                # accounted, and the ladder keeps its own telemetry
+                counter("slo_listener_errors_total").inc()
         return results
 
     def _eval_p99(self, window: dict) -> dict:

@@ -307,6 +307,56 @@ class TestDataRace:
         """)
         assert r.findings == []
 
+    def test_qos_ladder_level_needs_common_lock(self, tmp_path):
+        # qos-shaped fixture: the degradation level is written from the
+        # watchdog listener thread AND reset from the main root; without
+        # a shared lock that is exactly the race QT008 exists to catch
+        r = run_lint(tmp_path, prelude=REAP,
+                     name="quiver_tpu/resilience/qos_fixture.py", source="""
+            import threading
+
+            class Ladder:
+                def __init__(self):
+                    self.level = 0
+                    self._t = threading.Thread(target=self._watch)
+
+                def _watch(self):
+                    self.level += 1
+
+                def reset(self):
+                    self.level = 0
+
+                def stop(self):
+                    join_and_reap([self._t], 1.0, component="t")
+        """)
+        assert codes(r) == ["QT008"]
+        assert r.findings[0].message.count("level")
+
+    def test_qos_ladder_level_under_lock_is_clean(self, tmp_path):
+        # the shipped idiom: tick decisions under _lock, effects outside
+        r = run_lint(tmp_path, prelude=REAP,
+                     name="quiver_tpu/resilience/qos_fixture.py", source="""
+            import threading
+
+            class Ladder:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.level = 0
+                    self._t = threading.Thread(target=self._watch)
+
+                def _watch(self):
+                    with self._lock:
+                        self.level += 1
+
+                def reset(self):
+                    with self._lock:
+                        self.level = 0
+
+                def stop(self):
+                    join_and_reap([self._t], 1.0, component="t")
+        """)
+        assert r.findings == []
+
     def test_suppression_comment_silences_qt008(self, tmp_path):
         r = run_lint(tmp_path, prelude=REAP, source="""
             import threading

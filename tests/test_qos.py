@@ -1,0 +1,754 @@
+"""Multi-tenant QoS suite (``make qos``).
+
+Covers the overload-control tier end to end: token-bucket admission
+(typed ``QuotaExceeded`` answers with a retry-after hint), deficit-
+weighted round-robin fair lanes, the reversible SLO-driven degradation
+ladder, the shared backoff policy and the breaker's single-probe
+half-open gate, tenant plumbing through flight records and metric
+labels, ambient deadlines into the degraded dist paths, continuous
+batching's zero-retrace contract, and the closed-loop burst harness
+(``benchmarks/qos_load.py``) acceptance criteria.
+
+Everything is deterministic: scripted clocks for buckets/breakers,
+seeded RNGs for jitter, direct ``observe()`` ticks for the ladder, and
+a seeded arrival schedule in the harness.
+"""
+
+import os
+import queue
+import threading
+import time
+import random
+
+import numpy as np
+import jax
+import pytest
+
+import quiver_tpu.config as config_mod
+from quiver_tpu import (
+    Feature, GraphSageSampler, InferenceServer, RequestBatcher, telemetry,
+)
+from quiver_tpu.serving import ServingRequest, _STOP
+from quiver_tpu.telemetry import flightrec, metric_key
+from quiver_tpu.resilience import (
+    Backoff, BoundedLane, ChaosPlan, CircuitBreaker, DeadlineExceeded,
+    DegradationLadder, LadderStep, LoadShed, PeerTimeout, QoSController,
+    QuotaExceeded, TenantClass, TokenBucket, WeightedFairLane, deadline_scope,
+    check_ambient, install_qos, qos_from_config, qos_status, retry_call,
+    serving_ladder, shed,
+)
+from quiver_tpu.resilience import chaos
+from quiver_tpu.resilience import qos as qos_mod
+from quiver_tpu.resilience.deadline import ambient_deadline
+from quiver_tpu.resilience.qos import parse_tenant_spec
+
+pytestmark = pytest.mark.qos
+
+NHOSTS = 8
+
+_CFG_KEYS = (
+    "qos_enabled", "qos_tenants", "qos_default_tenant", "qos_ingest_tenant",
+    "qos_admit_window_ms", "qos_quantum", "qos_degrade_fanout_frac",
+    "qos_breach_ticks", "qos_recover_ticks",
+    "serving_deadline_ms", "serving_queue_depth",
+    "serving_queue_high_watermark", "serving_queue_low_watermark",
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_qos():
+    """Fresh registry/recorder/controller per test; config restored, and
+    no chaos plan may leak across tests."""
+    cfg = config_mod.get_config()
+    saved = {k: getattr(cfg, k) for k in _CFG_KEYS}
+    telemetry.set_enabled(True)
+    telemetry.reset()
+    qos_mod.reset()
+    config_mod.update(serving_deadline_ms=0)
+    yield
+    chaos.uninstall()
+    qos_mod.reset()
+    config_mod.update(**saved)
+    telemetry.set_enabled(True)
+    telemetry.reset()
+
+
+def counter_value(name, **labels):
+    return telemetry.snapshot()["counters"].get(metric_key(name, labels), 0)
+
+
+def gauge_value(name, **labels):
+    return telemetry.snapshot()["gauges"].get(metric_key(name, labels))
+
+
+def _req(ids=(1,), seq=0, priority=0, tenant=None, tenant_class=None,
+         deadline=None):
+    return ServingRequest(ids=np.asarray(ids, dtype=np.int64), client=0,
+                          seq=seq, priority=priority, deadline=deadline,
+                          tenant=tenant, tenant_class=tenant_class)
+
+
+class _Clock:
+    """Scripted monotonic clock."""
+
+    def __init__(self, t=0.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+
+def _classes():
+    return {
+        "gold": TenantClass("gold", rate=100.0, burst=50.0, weight=4.0,
+                            priority=3),
+        "silver": TenantClass("silver", rate=50.0, burst=20.0, weight=2.0,
+                              priority=2),
+        "bronze": TenantClass("bronze", rate=20.0, burst=10.0, weight=1.0,
+                              priority=1),
+        "ingest": TenantClass("ingest", rate=10.0, burst=5.0, weight=1.0,
+                              priority=0),
+    }
+
+
+def _controller(clock=time.monotonic):
+    return QoSController(classes=_classes(), default="bronze",
+                         ingest="ingest", clock=clock)
+
+
+# ===================================================== token bucket
+def test_token_bucket_burst_retry_after_and_refill():
+    clk = _Clock()
+    tb = TokenBucket(rate=10.0, burst=5.0, clock=clk)
+    assert tb.tokens == 5.0
+    for _ in range(5):
+        assert tb.try_take() == 0.0
+    # empty: retry-after is the exact refill time for one token
+    assert tb.try_take() == pytest.approx(0.1)
+    # partial refill shortens the hint
+    clk.t = 0.05
+    assert tb.try_take() == pytest.approx(0.05)
+    clk.t = 0.1
+    assert tb.try_take() == 0.0
+    # refill is capped at burst: a long idle period banks at most 5
+    clk.t = 1000.0
+    for _ in range(5):
+        assert tb.try_take() == 0.0
+    assert tb.try_take() > 0.0
+    # multi-token takes hint proportionally
+    tb2 = TokenBucket(rate=4.0, burst=2.0, clock=_Clock())
+    assert tb2.try_take(2.0) == 0.0
+    assert tb2.try_take(2.0) == pytest.approx(0.5)
+
+
+# ===================================================== tenant spec
+def test_parse_tenant_spec_roundtrip():
+    classes = parse_tenant_spec(
+        "gold:rate=200,burst=50,weight=8,priority=3; bronze")
+    assert classes["gold"] == TenantClass("gold", 200.0, 50.0, 8.0, 3)
+    # bare name: all defaults
+    assert classes["bronze"].rate == 100.0
+    assert classes["bronze"].priority == 0
+
+
+@pytest.mark.parametrize("spec", [
+    "",                       # no classes at all
+    "gold:speed=9",           # unknown field
+    ":rate=1",                # missing name
+    "gold:rate=0",            # quota must be positive
+    "gold:burst=-1",
+    "gold:rate=abc",          # non-numeric
+])
+def test_parse_tenant_spec_rejects_malformed(spec):
+    with pytest.raises(ValueError):
+        parse_tenant_spec(spec)
+
+
+# ===================================================== controller
+def test_resolve_allowlists_and_floor_excludes_ingest():
+    ctl = _controller()
+    assert ctl.resolve("gold").name == "gold"
+    assert ctl.resolve(None).name == "bronze"
+    # unknown tenants map to the default class — the metric-label
+    # allowlist is the declared class set, never client input
+    assert ctl.resolve("mystery").name == "bronze"
+    # ingest has the lowest priority but is not a floor candidate
+    assert ctl.floor == "bronze"
+
+
+def test_admit_stamps_class_and_lifts_priority():
+    ctl = _controller()
+    req = _req(tenant="mystery", priority=0)
+    assert ctl.admit(req, None)
+    assert req.tenant_class == "bronze"
+    assert req.priority == 1  # lifted to the class priority
+    high = _req(tenant="gold", priority=9)
+    assert ctl.admit(high, None)
+    assert high.priority == 9  # never lowered
+    assert counter_value("serving_qos_admitted_total", tenant="bronze") == 1
+    assert counter_value("serving_qos_admitted_total", tenant="gold") == 1
+
+
+def test_quota_rejection_answers_quota_exceeded():
+    clk = _Clock()
+    classes = {
+        "gold": TenantClass("gold", rate=10.0, burst=2.0, weight=1.0,
+                            priority=1),
+        "bronze": TenantClass("bronze", rate=1.0, burst=1.0),
+    }
+    ctl = QoSController(classes=classes, default="bronze", ingest="none",
+                        clock=clk)
+    rq = queue.Queue()
+    r1, r2, r3 = (_req(tenant="gold", seq=i) for i in (1, 2, 3))
+    assert ctl.admit(r1, rq) and ctl.admit(r2, rq)
+    assert not ctl.admit(r3, rq)
+    req, exc = rq.get_nowait()
+    assert req is r3 and isinstance(exc, QuotaExceeded)
+    assert exc.tenant == "gold"
+    assert exc.retry_after_s == pytest.approx(0.1)
+    assert counter_value("serving_qos_rejected_total", tenant="gold") == 1
+    assert counter_value("serving_qos_admitted_total", tenant="gold") == 2
+    rec = flightrec.get_recorder().get(r3.trace.trace_id)
+    assert rec is not None and rec["status"] == "rejected"
+    # the hint is honest: waiting it out readmits
+    clk.t = 0.1
+    assert ctl.admit(_req(tenant="gold", seq=4), rq)
+
+
+# ===================================================== weighted-fair lane
+def test_wfl_drr_drains_by_weight():
+    rq = queue.Queue()
+    lane = WeightedFairLane("device", {"gold": 4.0, "bronze": 1.0},
+                            default_class="bronze", quantum=1,
+                            maxsize=64, high=1.0, low=0.5, result_queue=rq)
+    for i in range(8):
+        lane.put(_req(seq=i, tenant_class="bronze"))
+    for i in range(8, 16):
+        lane.put(_req(seq=i, tenant_class="gold"))
+    assert lane.class_depths() == {"bronze": 8, "gold": 8}
+    order = [lane.get_nowait().tenant_class for _ in range(16)]
+    # DRR with quantum=1: gold's 4x weight gives it 4 dequeues per
+    # bronze dequeue while both classes are backlogged
+    assert order == (["bronze"] + ["gold"] * 4) * 2 + ["bronze"] * 6
+    assert rq.empty()  # fairness never sheds
+
+
+def test_wfl_unstamped_requests_ride_default_class():
+    lane = WeightedFairLane("device", {"gold": 4.0, "bronze": 1.0},
+                            default_class="bronze", maxsize=8,
+                            result_queue=queue.Queue())
+    lane.put(_req(seq=0))  # no tenant_class stamp
+    assert lane.class_depths() == {"bronze": 1}
+
+
+def test_wfl_control_fence_preserves_arrival_order():
+    lane = WeightedFairLane("device", {"gold": 1.0}, default_class="gold",
+                            maxsize=2, high=1.0, low=0.5,
+                            result_queue=queue.Queue())
+    a = _req(seq=0)
+    b = _req(seq=1)
+    lane.put(a)
+    lane.put(_STOP)   # arrives between a and b
+    lane.put(b)       # at capacity 2 the control item still went through
+    assert lane.get_nowait() is a
+    assert lane.get_nowait() is _STOP  # only after every earlier request
+    assert lane.get_nowait() is b
+
+
+def test_wfl_watermark_sheds_lowest_class_under_interleave():
+    """Satellite: watermark hysteresis with interleaved multi-tenant
+    enqueue — sheds land on the lowest class PRESENT no matter whose
+    burst crossed the watermark, and admissions resume below ``low``."""
+    rq = queue.Queue()
+    lane = WeightedFairLane("device", {"gold": 4.0, "bronze": 1.0},
+                            default_class="bronze", maxsize=10,
+                            high=0.5, low=0.2, result_queue=rq)
+    # interleave the two tenants up to the high watermark (5)
+    reqs = []
+    for i in range(5):
+        cls, pri = (("bronze", 1) if i % 2 == 0 else ("gold", 3))
+        r = _req(seq=i, priority=pri, tenant_class=cls)
+        reqs.append(r)
+        lane.put(r)
+    assert not lane.shedding
+    # a gold arrival at the watermark displaces the OLDEST bronze
+    g5 = _req(seq=5, priority=3, tenant_class="gold")
+    lane.put(g5)
+    assert lane.shedding
+    victim, exc = rq.get_nowait()
+    assert victim is reqs[0] and victim.tenant_class == "bronze"
+    assert isinstance(exc, LoadShed) and exc.reason == "watermark"
+    # a bronze arrival while shedding finds no lower class: it sheds
+    b6 = _req(seq=6, priority=1, tenant_class="bronze")
+    lane.put(b6)
+    shed_req, _ = rq.get_nowait()
+    assert shed_req is b6
+    # tenant-labelled accounting (bounded by the class allowlist)
+    assert counter_value("serving_shed_total", reason="watermark",
+                         lane="device", tenant="bronze") == 2
+    # hysteresis: draining below low (2) releases shedding
+    while lane.qsize() >= 2:
+        lane.get_nowait()
+    b7 = _req(seq=7, priority=1, tenant_class="bronze")
+    lane.put(b7)
+    assert not lane.shedding
+    assert rq.empty()
+
+
+# ===================================================== degradation ladder
+def test_ladder_hysteresis_and_reversal_order():
+    calls = []
+    steps = [
+        LadderStep("s1", lambda: calls.append("+1"),
+                   lambda: calls.append("-1")),
+        LadderStep("s2", lambda: calls.append("+2"),
+                   lambda: calls.append("-2")),
+    ]
+    lad = DegradationLadder(steps, breach_ticks=2, recover_ticks=2)
+    # alternating windows never flap the ladder
+    for _ in range(3):
+        lad.observe(True)
+        lad.observe(False)
+    assert lad.level == 0 and calls == []
+    # two consecutive breaches per step-down
+    lad.observe(True)
+    assert lad.level == 0
+    lad.observe(True)
+    assert lad.level == 1 and calls == ["+1"]
+    lad.observe(True)
+    lad.observe(True)
+    assert lad.level == 2 and calls == ["+1", "+2"]
+    # saturated at the bottom: more breaches apply nothing new
+    lad.observe(True)
+    lad.observe(True)
+    assert lad.level == 2 and calls == ["+1", "+2"]
+    assert gauge_value("serving_degradation_level") == 2
+    # recovery reverts newest-first, same hysteresis
+    lad.observe(False)
+    lad.observe(False)
+    assert lad.level == 1 and calls[-1] == "-2"
+    lad.observe(False)
+    lad.observe(False)
+    assert lad.level == 0 and calls == ["+1", "+2", "-2", "-1"]
+    assert gauge_value("serving_degradation_level") == 0
+    for direction, step in (("down", "s1"), ("down", "s2"),
+                            ("up", "s2"), ("up", "s1")):
+        assert counter_value("serving_qos_ladder_transitions_total",
+                             direction=direction, step=step) == 1
+    st = lad.status()
+    assert st["level"] == 0 and len(st["history"]) == 4
+    with pytest.raises(ValueError):
+        DegradationLadder(steps, breach_ticks=0)
+
+
+def test_ladder_attach_filters_objectives():
+    class _WD:
+        def __init__(self):
+            self.listeners = []
+
+        def add_listener(self, fn):
+            self.listeners.append(fn)
+
+    lad = DegradationLadder([LadderStep("s", lambda: None, lambda: None)],
+                            breach_ticks=1, recover_ticks=1)
+    wd = _WD()
+    assert lad.attach(wd, objectives=("p99_latency",)) is lad
+    (fire,) = wd.listeners
+    # a breach on an unwatched objective counts as a healthy tick
+    fire([{"objective": "error_ratio", "breaching": True}])
+    assert lad.level == 0
+    fire([{"objective": "p99_latency", "breaching": True},
+          {"objective": "error_ratio", "breaching": False}])
+    assert lad.level == 1
+
+
+def test_serving_ladder_full_reversal():
+    class _Sampler:
+        fanout_frac = 1.0
+
+        def set_fanout_frac(self, f):
+            self.fanout_frac = f
+
+    class _ColdCache:
+        admission_paused = False
+
+    clk = _Clock()
+    ctl = _controller(clock=clk)
+    sampler, cc = _Sampler(), _ColdCache()
+    lad = serving_ladder(ctl, sampler=sampler, cold_cache=cc,
+                         fanout_frac=0.5, breach_ticks=1, recover_ticks=1)
+    assert ctl.ladder is lad
+    # walk the full ladder down: fanout -> coldcache -> cpu_floor -> shed
+    for _ in range(4):
+        lad.observe(True)
+    assert lad.level == 4
+    assert sampler.fanout_frac == 0.5
+    assert cc.admission_paused
+    assert ctl.route_floor_to_cpu and ctl.shed_floor
+    # at the bottom, the floor class is shed at admission — answered,
+    # not dropped — while higher classes still pass
+    rq = queue.Queue()
+    floor_req = _req(tenant="bronze", seq=0)
+    assert not ctl.admit(floor_req, rq)
+    req, exc = rq.get_nowait()
+    assert req is floor_req
+    assert isinstance(exc, LoadShed) and exc.reason == "degraded"
+    assert ctl.admit(_req(tenant="gold", seq=1), rq)
+    # full reversal: every step reverts, newest first
+    for _ in range(4):
+        lad.observe(False)
+    assert lad.level == 0
+    assert sampler.fanout_frac == 1.0
+    assert not cc.admission_paused
+    assert not ctl.route_floor_to_cpu and not ctl.shed_floor
+    assert gauge_value("serving_degradation_level") == 0
+    assert ctl.admit(_req(tenant="bronze", seq=2), rq)
+
+
+# ===================================================== shared backoff
+def test_backoff_deterministic_schedule():
+    b = Backoff(1.0, cap_s=8.0)
+    assert [b.delay(i) for i in range(5)] == [1.0, 2.0, 4.0, 8.0, 8.0]
+    # seeded jitter replays identically and stays inside its bounds
+    d1 = [Backoff(0.1, cap_s=1.0, jitter=0.5,
+                  rng=random.Random(7)).delay(i) for i in range(6)]
+    d2 = [Backoff(0.1, cap_s=1.0, jitter=0.5,
+                  rng=random.Random(7)).delay(i) for i in range(6)]
+    assert d1 == d2
+    undithered = [min(0.1 * 2 ** i, 1.0) for i in range(6)]
+    assert d1 != undithered  # the jitter actually moved the schedule
+    for d, base in zip(d1, undithered):
+        assert base * 0.5 <= d <= base * 1.5
+    with pytest.raises(ValueError):
+        Backoff(-1.0)
+    with pytest.raises(ValueError):
+        Backoff(1.0, jitter=1.0)
+    with pytest.raises(ValueError):
+        Backoff(1.0, multiplier=0.5)
+
+
+def test_retry_call_schedule_and_propagation():
+    sleeps, retries = [], []
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise PeerTimeout()
+        return "ok"
+
+    out = retry_call(flaky, attempts=3, backoff=Backoff(1.0, cap_s=8.0),
+                     retry_on=(PeerTimeout,), sleep=sleeps.append,
+                     on_retry=lambda a, e: retries.append(a))
+    assert out == "ok" and calls["n"] == 3
+    assert sleeps == [1.0, 2.0] and retries == [0, 1]
+
+    # a non-retryable exception propagates without a second attempt
+    calls["n"] = 0
+
+    def wrong():
+        calls["n"] += 1
+        raise ValueError("not transient")
+
+    with pytest.raises(ValueError):
+        retry_call(wrong, attempts=5, retry_on=(PeerTimeout,),
+                   sleep=sleeps.append)
+    assert calls["n"] == 1
+
+    # exhausted attempts surface the last failure; one sleep between two
+    sleeps2 = []
+    with pytest.raises(PeerTimeout):
+        retry_call(lambda: (_ for _ in ()).throw(PeerTimeout()),
+                   attempts=2, backoff=Backoff(0.5), retry_on=(PeerTimeout,),
+                   sleep=sleeps2.append)
+    assert sleeps2 == [0.5]
+    with pytest.raises(ValueError):
+        retry_call(flaky, attempts=0)
+
+
+# ===================================================== breaker half-open
+def test_breaker_half_open_admits_single_probe():
+    clk = _Clock()
+    br = CircuitBreaker("qos.halfopen", failure_threshold=1,
+                        reset_timeout_s=1.0, half_open_probes=3, clock=clk)
+    br.record_failure()
+    assert br.state == "open" and not br.allow()
+    clk.t = 1.0
+    assert br.allow()          # wins the probe slot
+    assert br.state == "half_open"
+    for _ in range(5):
+        assert not br.allow()  # every other caller sees it as closed off
+    br.record_success()
+    assert br.state == "closed" and br.allow()
+
+
+def test_breaker_half_open_single_probe_under_concurrency():
+    """Regression: half-open used to admit EVERY concurrent caller as a
+    probe, stampeding a barely-recovered lane with the burst that
+    tripped it.  Exactly one of N racing callers may probe."""
+    clk = _Clock()
+    br = CircuitBreaker("qos.stampede", failure_threshold=1,
+                        reset_timeout_s=1.0, half_open_probes=3, clock=clk)
+    br.record_failure()
+    clk.t = 1.0
+    n = 8
+    results = []
+    lock = threading.Lock()
+    barrier = threading.Barrier(n)
+
+    def caller():
+        barrier.wait()
+        ok = br.allow()
+        with lock:
+            results.append(ok)
+
+    threads = [threading.Thread(target=caller) for _ in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=5)
+    assert sum(results) == 1
+
+
+def test_breaker_reopen_backs_off_probe_schedule():
+    clk = _Clock()
+    br = CircuitBreaker("qos.backoff", failure_threshold=1,
+                        reset_timeout_s=1.0, half_open_probes=1, clock=clk)
+    br.record_failure()                     # open at t=0
+    clk.t = 1.0
+    assert br.allow()
+    br.record_failure()                     # probe 1 fails: reopen at 1.0
+    clk.t = 1.9
+    assert not br.allow()                   # base timeout still 1.0
+    clk.t = 2.0
+    assert br.allow()
+    br.record_failure()                     # probe 2 fails: timeout 2.0
+    clk.t = 3.9
+    assert not br.allow()
+    clk.t = 4.0
+    assert br.allow()
+    br.record_success()                     # recovery resets the backoff
+    assert br.state == "closed"
+    br.record_failure()                     # trips again at t=4.0
+    clk.t = 5.0
+    assert br.allow()                       # back to the base timeout
+
+
+# ===================================================== tenant plumbing
+def test_tenant_rides_trace_into_flight_record():
+    req = _req(tenant="gold", seq=0)
+    assert req.trace is not None and req.trace.tenant == "gold"
+    shed(req, queue.Queue(), "device", "watermark")
+    rec = flightrec.get_recorder().get(req.trace.trace_id)
+    assert rec is not None and rec["tenant"] == "gold"
+
+
+def test_disabled_qos_keeps_seed_metric_keys():
+    """QoS off: no controller, plain BoundedLanes, and shed/reject
+    metric keys byte-identical to the pre-QoS ones (no tenant label)."""
+    config_mod.update(qos_enabled=False, serving_queue_depth=4,
+                      serving_queue_high_watermark=0.75,
+                      serving_queue_low_watermark=0.25)
+    qos_mod.reset()
+    assert qos_from_config() is None
+    rq = queue.Queue()
+    rb = RequestBatcher([queue.Queue()], mode="CPU", result_queue=rq)
+    assert type(rb.cpu_batched_queue) is BoundedLane
+    assert rb._qos is None
+    for i in range(6):
+        rb._route(_req(ids=(1, 2), seq=i))
+    snap = telemetry.snapshot()["counters"]
+    shed_keys = [k for k in snap if k.startswith("serving_shed_total")]
+    assert shed_keys and all("tenant=" not in k for k in shed_keys)
+    assert counter_value("serving_shed_total", reason="watermark",
+                         lane="cpu") >= 1
+    assert not any(k.startswith("serving_qos_") for k in snap)
+
+
+def test_enabled_qos_builds_weighted_fair_lanes():
+    config_mod.update(serving_queue_depth=8)
+    ctl = _controller()
+    rb = RequestBatcher([queue.Queue()], mode="Device",
+                        result_queue=queue.Queue(), qos=ctl)
+    assert isinstance(rb.device_batched_queue, WeightedFairLane)
+    req = _req(tenant="gold", seq=0)
+    rb._route(req)
+    assert req.tenant_class == "gold" and req.priority == 3
+    assert rb.device_batched_queue.class_depths() == {"gold": 1}
+    assert counter_value("serving_qos_admitted_total", tenant="gold") == 1
+
+
+def test_route_floor_to_cpu_reroutes_only_floor_class():
+    config_mod.update(serving_queue_depth=8)
+    ctl = _controller()
+    ctl.route_floor_to_cpu = True  # ladder L3 in force
+    rb = RequestBatcher([queue.Queue()], mode="Auto",
+                        result_queue=queue.Queue(), qos=ctl)
+    rb._route(_req(tenant="bronze", seq=0))
+    rb._route(_req(tenant="gold", seq=1))
+    assert rb.cpu_batched_queue.class_depths() == {"bronze": 1}
+    assert rb.device_batched_queue.class_depths() == {"gold": 1}
+
+
+# ===================================================== ambient deadlines
+def test_deadline_scope_nesting_and_noop():
+    assert ambient_deadline() is None
+    check_ambient("nowhere")  # no scope: one contextvar read, no raise
+    with deadline_scope(None):
+        assert ambient_deadline() is None
+    dl = time.perf_counter() + 5.0
+    with deadline_scope(dl):
+        assert ambient_deadline() == dl
+        check_ambient("live")
+        with deadline_scope(dl - 10.0, dl - 11.0):
+            with pytest.raises(DeadlineExceeded) as ei:
+                check_ambient("inner")
+            assert ei.value.lane == "inner"
+        assert ambient_deadline() == dl  # outer scope restored
+    assert ambient_deadline() is None
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    from quiver_tpu.utils.mesh import make_mesh
+
+    assert jax.device_count() == NHOSTS
+    return make_mesh(("data",))
+
+
+def test_ambient_deadline_refuses_degraded_dist_lookup(mesh, rng):
+    """Satellite: the serving loop's ambient deadline propagates into
+    DistFeature — an expired batch is refused BEFORE the lookup does any
+    work (the chaos exchange point is never even reached)."""
+    from quiver_tpu.dist import DistFeature, PartitionInfo
+
+    n, d = 128, 4
+    full = rng.normal(size=(n, d)).astype(np.float32)
+    g2h = rng.integers(0, NHOSTS, n).astype(np.int32)
+    info = PartitionInfo(host=0, hosts=NHOSTS, global2host=g2h)
+    df = DistFeature.from_global_feature(full, mesh, info)
+    ids = rng.integers(0, n, (NHOSTS, 16)).astype(np.int32)
+    # a live scope changes nothing
+    with deadline_scope(time.perf_counter() + 60.0):
+        out = np.asarray(df.lookup(ids))
+    assert out.shape == (NHOSTS, 16, d)
+    # an expired scope refuses the work up front
+    plan = ChaosPlan(seed=1).fail("dist.feature.exchange",
+                                  exc=PeerTimeout, times=8)
+    with chaos.active(plan):
+        with deadline_scope(time.perf_counter() - 0.01):
+            with pytest.raises(DeadlineExceeded) as ei:
+                df.lookup(ids)
+    assert ei.value.lane == "dist_feature"
+    assert plan.hits("dist.feature.exchange") == 0
+
+
+# ===================================================== continuous batching
+def test_continuous_batching_steady_state_zero_builds(small_graph, rng):
+    """Acceptance: the admit window coalesces late arrivals into the
+    in-flight batch without changing executable keying — after warm-up,
+    a burst served through continuous batching builds ZERO new
+    executables."""
+    from quiver_tpu.analysis.retrace_guard import count_jit_builds
+    from quiver_tpu.models import GraphSAGE
+
+    config_mod.update(qos_admit_window_ms=2.0)
+    ctl = _controller()
+    n = small_graph.node_count
+    feat = rng.normal(size=(n, 4)).astype(np.float32)
+    feature = Feature(device_cache_size="1G").from_cpu_tensor(feat)
+    sampler = GraphSageSampler(small_graph, [3], mode="CPU")
+    model = GraphSAGE(hidden=8, out_dim=2, num_layers=1, dropout=0.0)
+    b0 = sampler.sample(np.arange(8, dtype=np.int64))
+    params = model.init(jax.random.PRNGKey(0),
+                        feature[np.asarray(b0.n_id)], b0.layers)
+    apply_fn = lambda p, x, blocks: model.apply(p, x, blocks)
+    dq = queue.Queue()
+    server = InferenceServer(sampler, feature, apply_fn, params, dq,
+                             max_coalesce=4, qos=ctl)
+    assert server._admit_window_s > 0  # continuous batching armed
+    server.start()
+    try:
+        # warm-up: one pass per coalesced-total bucket a 4x8 burst can
+        # produce (8, 16, 24->32, 32), each request served alone
+        for size in (8, 16, 24, 32):
+            dq.put(_req(ids=rng.integers(0, n, size), seq=size,
+                        tenant="gold"))
+            _, out = server.result_queue.get(timeout=60)
+            assert not isinstance(out, Exception), out
+        with count_jit_builds() as c:
+            for i in range(12):
+                dq.put(_req(ids=rng.integers(0, n, 8), seq=100 + i,
+                            tenant="gold"))
+            for _ in range(12):
+                _, out = server.result_queue.get(timeout=60)
+                assert not isinstance(out, Exception), out
+                assert out.shape == (8, 2)
+        assert c.builds == 0, c.describe()
+    finally:
+        server.stop()
+
+
+# ===================================================== debug endpoint
+def test_qos_status_payload():
+    config_mod.update(qos_enabled=False)
+    qos_mod.reset()
+    assert qos_status() == {"enabled": False, "installed": False}
+    ctl = install_qos(_controller())
+    serving_ladder(ctl, fanout_frac=0.5, breach_ticks=1, recover_ticks=1)
+    st = qos_status()
+    assert st["installed"] and st["floor"] == "bronze"
+    assert {c["name"] for c in st["classes"]} == {"gold", "silver",
+                                                  "bronze", "ingest"}
+    assert st["ladder"]["level"] == 0
+    assert st["ladder"]["steps"] == ["fanout", "coldcache", "cpu_floor",
+                                     "shed_floor"]
+    assert "tokens" in st and not st["shed_floor"]
+
+
+# ===================================================== burst harness
+def _load_qos_harness():
+    import importlib.util
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(root, "benchmarks", "qos_load.py")
+    spec = importlib.util.spec_from_file_location("qos_load_harness", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_qos_load_harness_acceptance():
+    """The closed-loop burst harness meets the overload-control
+    acceptance criteria: under a 10x zipfian burst with mid-burst chaos
+    faults, no admitted tenant starves, the top class keeps its loss
+    far below the floor class's, quota rejections land on the heavy
+    hitter only, the ladder engages, and it fully reverses once the
+    burst passes."""
+    harness = _load_qos_harness()
+    rep = harness.run_qos_load(smoke=True, seed=0)
+
+    def loss(entry):
+        return (entry["shed"] + entry["rejected"]) / max(entry["offered"], 1)
+
+    burst = {t: rep["tenants"][t]["burst"] for t in harness.STEADY_RPS}
+    # no starvation: every admitted tenant completes work mid-burst
+    for tenant, e in burst.items():
+        assert e["offered"] > 0, tenant
+        assert e["ok"] > 0, (tenant, e)
+        assert e["ok"] / e["offered"] >= 0.05, (tenant, e)
+    # the top class holds: its loss stays small and far below the
+    # floor class's (sheds and quota rejections land on bronze first)
+    assert burst["gold"]["rejected"] == 0
+    assert loss(burst["gold"]) <= 0.3
+    assert loss(burst["gold"]) < loss(burst["bronze"])
+    # the zipfian heavy hitter is the one the token bucket throttles
+    assert burst["bronze"]["rejected"] > 0
+    # the ladder engaged under the burst...
+    assert rep["peak_level"] >= 2
+    # ...and fully reversed afterwards: level 0, fanout and coldcache
+    # admission restored
+    assert rep["final_level"] == 0
+    assert rep["fanout_frac"] == 1.0
+    assert not rep["coldcache_paused"]
+    assert rep["ladder"]["level"] == 0

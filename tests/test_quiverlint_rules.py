@@ -511,6 +511,41 @@ class TestSilentExcept:
         """, name="quiver_tpu/stream/compactor.py")
         assert r.findings == []
 
+    def test_flags_silent_admission_loop_in_qos_module(self, tmp_path):
+        # the qos module rides the default resilience/*.py hot glob: an
+        # admission loop that swallows quota failures would silently
+        # starve a tenant with no rejected-counter evidence
+        r = run_lint(tmp_path, """
+            class QoSController:
+                def _admit_loop(self, q):
+                    while True:
+                        req = q.get()
+                        try:
+                            self._take_tokens(req)
+                        except Exception:
+                            continue
+        """, name="quiver_tpu/resilience/qos.py")
+        assert codes(r) == ["QT007"]
+
+    def test_qos_answering_rejections_is_clean(self, tmp_path):
+        # the shipped idiom: every quota failure is answered on the
+        # result queue and ticked, never dropped
+        r = run_lint(tmp_path, """
+            from quiver_tpu import telemetry
+
+            class QoSController:
+                def _admit_loop(self, q, results):
+                    while True:
+                        req = q.get()
+                        try:
+                            self._take_tokens(req)
+                        except Exception as e:
+                            telemetry.counter(
+                                "serving_qos_rejected_total").inc()
+                            results.put((req, e))
+        """, name="quiver_tpu/resilience/qos.py")
+        assert r.findings == []
+
     def test_reraise_is_clean(self, tmp_path):
         r = run_lint(tmp_path, """
             def run(q):
