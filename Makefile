@@ -1,6 +1,7 @@
 # Top-level convenience targets (parity: reference ./configure && make).
 .PHONY: all native test test-quick test-native asan bench smoke \
-	telemetry-check chaos stream lint sanitize recovery crash qos help
+	telemetry-check chaos stream lint sanitize recovery crash qos \
+	paged help
 
 all: native
 
@@ -65,5 +66,11 @@ qos:
 	python -m pytest tests/ -m qos -q
 	python benchmarks/qos_load.py --smoke
 
+# paged feature store + ragged page-gather kernel suite: bit-identical
+# equivalence vs the staged merge, retrace budget, page-residency
+# recovery (docs/FEATURE_CACHE.md)
+paged:
+	python -m pytest tests/ -m paged -q
+
 help:
-	@echo "targets: native | test | test-quick | test-native | asan | bench | smoke | telemetry-check | chaos | stream | lint | sanitize | recovery | crash | qos"
+	@echo "targets: native | test | test-quick | test-native | asan | bench | smoke | telemetry-check | chaos | stream | lint | sanitize | recovery | crash | qos | paged | help"

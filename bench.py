@@ -15,6 +15,7 @@ the other sections ride along under ``"sections"``.  Details to stderr.
 """
 
 import argparse
+import contextlib
 import json
 import os
 import sys
@@ -779,6 +780,109 @@ def bench_feature_coldcache(n_nodes, dim, batch_rows, iters=30,
     return out
 
 
+def bench_feature_paged(n_nodes, dim, batch_rows, iters=20, epochs=3):
+    """A/B of the paged store + ragged page-gather kernel vs the staged
+    three-tier merge on the budgeted (20% hot) tier (ROADMAP item 2).
+
+    Same recurring-zipf protocol as ``bench_feature_coldcache``:
+    ``epochs`` passes over one fixed ``iters``-batch stream through a
+    staged-merge feature (overlay on) and a paged feature.  Reported
+    per mode: steady-state ms per 1M gathered elements, H2D bytes per
+    epoch, and the executable count — programs resident after the
+    warmup epoch plus builds observed DURING the steady epochs (the
+    paged path's collapse of the additive bucket grid is the point;
+    ``retrace_guard.count_jit_builds`` measures it, not an estimate).
+
+    Honesty: on a non-TPU backend the kernel runs in Pallas interpret
+    mode — logic-exact, performance-meaningless — so the section stamps
+    ``source="cpu_rehearsal"`` and the driver headline never quotes it
+    as a live number (same convention as every committed measurement).
+    """
+    import jax
+
+    from quiver_tpu import Feature, telemetry
+    from quiver_tpu.analysis.retrace_guard import count_jit_builds
+
+    rng = np.random.default_rng(11)
+    feat = rng.normal(size=(n_nodes, dim)).astype(np.float32)
+    B = min(batch_rows, 4096)
+    hot_rows = int(0.2 * n_nodes)
+    elems_m = B * dim / 1e6  # gathered elements per batch, in millions
+
+    def h2d():
+        if not telemetry.enabled():
+            return 0.0
+        return telemetry.snapshot()["counters"].get(
+            "feature_h2d_bytes_total", 0.0)
+
+    out = {"rows": B, "hot_rows": hot_rows, "epochs": epochs,
+           "n_nodes": n_nodes, "backend": jax.default_backend()}
+    if jax.default_backend() != "tpu":
+        out["source"] = "cpu_rehearsal"
+    p = 1.0 / np.arange(1, n_nodes + 1) ** 0.9
+    p /= p.sum()
+    streams = [rng.choice(n_nodes, size=B, p=p) for _ in range(iters)]
+    for mode in ("staged", "paged"):
+        f = Feature(device_cache_size=hot_rows,
+                    cache_unit="rows").from_cpu_tensor(feat)
+        if mode == "staged":
+            f.enable_cold_cache(admit_threshold=2)
+        else:
+            # pool sized to the batch working set (worst case: every
+            # cold row on its own page) so the A/B measures the ragged
+            # kernel, not the staged fallback — the auto default sizes
+            # for steady serving, not a cold zipf sweep
+            f.enable_paging(pool_pages=B)
+        ep_ms, ep_bytes = [], []
+        steady_builds = 0
+        for e in range(epochs):
+            counting = (count_jit_builds() if e == epochs - 1
+                        else contextlib.nullcontext())
+            before = h2d()
+            t0 = time.perf_counter()
+            with counting as counter:
+                for ids in streams:
+                    r = f[ids]
+                r.block_until_ready()
+            ep_ms.append((time.perf_counter() - t0) / iters * 1e3)
+            ep_bytes.append(h2d() - before)
+            if e == epochs - 1:
+                steady_builds = counter.builds
+        out[f"ms_per_1m_elems_{mode}"] = round(ep_ms[-1] / elems_m, 3)
+        out[f"ms_per_batch_{mode}"] = round(ep_ms[-1], 3)
+        out[f"ms_per_batch_cold_{mode}"] = round(ep_ms[0], 3)
+        out[f"h2d_bytes_{mode}"] = ep_bytes[-1]
+        out[f"executables_{mode}"] = len(f._merge_cache)
+        out[f"steady_builds_{mode}"] = steady_builds
+        if mode == "paged":
+            st = f.paged.stats()
+            out["page_rows"] = st["page_rows"]
+            out["page_bytes"] = st["page_bytes"]
+            out["pool_pages"] = st["pool_pages"]
+            out["page_fallbacks"] = st["fallbacks"]
+            out["page_hit_rate"] = round(
+                st["cache"]["hit_rate"], 4) if st["cache"] else None
+    if out.get("h2d_bytes_paged"):
+        out["h2d_ratio"] = round(
+            out["h2d_bytes_staged"] / out["h2d_bytes_paged"], 2)
+    out["speedup"] = round(
+        out["ms_per_batch_staged"]
+        / max(out["ms_per_batch_paged"], 1e-9), 3)
+    out["executable_ratio"] = round(
+        out["executables_staged"]
+        / max(out["executables_paged"], 1), 2)
+    h2d_note = (f"h2d x{out['h2d_ratio']}" if "h2d_ratio" in out
+                else "paged steady-state h2d: 0 bytes")
+    log(f"feature_paged ({'cpu rehearsal' if 'source' in out else 'live'}"
+        f"): staged {out['ms_per_1m_elems_staged']} ms/1M elems with "
+        f"{out['executables_staged']} programs, paged "
+        f"{out['ms_per_1m_elems_paged']} ms/1M elems with "
+        f"{out['executables_paged']} programs "
+        f"(steady-state builds: {out['steady_builds_paged']}), "
+        f"{h2d_note}")
+    return out
+
+
 # ---------------------------------------------------------------- e2e epoch
 def bench_e2e(topo, dim, classes, batch_size, steps, dedup="none",
               hidden=256, warmup=2, dtype=None, gather_mode="auto"):
@@ -1450,7 +1554,8 @@ def main():
                     help="reduced sizes for smoke testing")
     ap.add_argument("--iters", type=int, default=20)
     ap.add_argument("--sections",
-                    default="sampling,feature,feature_coldcache,e2e,"
+                    default="sampling,feature,feature_coldcache,"
+                            "feature_paged,e2e,"
                             "serving,serving_flightrec,"
                             "serving_resilience,serving_qos,"
                             "stream_ingest,restart_warm,quality",
@@ -1563,6 +1668,14 @@ def main():
                        lambda: bench_feature_coldcache(
                            n_nodes, feat_dim, feat_rows,
                            iters=max(20, args.iters * 3)))
+        if "feature_paged" in want:
+            # products-scale by default (n_nodes = 2.45M when not
+            # --small): the CPU rehearsal entry the driver can emit
+            # honestly while no TPU tunnel is up
+            runner.run("feature_paged", 900,
+                       lambda: bench_feature_paged(
+                           n_nodes, feat_dim, feat_rows,
+                           iters=max(10, args.iters)))
 
     def run_e2e_sections(gm):
         B = 1024 if not args.small else 256
@@ -1627,7 +1740,7 @@ def main():
     # the window.  If the probe later picks a different winner, the
     # post-probe pass below invalidates and re-measures them.
     gm_default = args.gather_mode or resolve_gather_mode("auto")
-    if want & {"feature", "feature_coldcache"}:
+    if want & {"feature", "feature_coldcache", "feature_paged"}:
         run_feature_sections()
     if "e2e" in want:
         run_e2e_sections(gm_default)

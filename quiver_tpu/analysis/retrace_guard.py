@@ -171,6 +171,14 @@ def count_jit_builds():
         patch(Feature, "_admit_fn",
               _count_cache_growth(counter, "feature._admit_fn",
                                   "_merge_cache"))
+        # paged path: the ragged-gather program and the page-fault
+        # scatter both key into _merge_cache via their own accessors
+        patch(Feature, "_paged_fn",
+              _count_cache_growth(counter, "feature._paged_fn",
+                                  "_merge_cache"))
+        patch(Feature, "_paged_fault_fn",
+              _count_cache_growth(counter, "feature._paged_fault_fn",
+                                  "_merge_cache"))
     except ImportError:
         pass
     try:

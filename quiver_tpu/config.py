@@ -52,6 +52,22 @@ class Config:
     cold_cache_admit: int = field(
         default_factory=lambda: _env("COLD_CACHE_ADMIT", 2, int)
     )
+    # paged feature store (docs/FEATURE_CACHE.md): "off" (default) keeps
+    # the staged three-tier merge byte-identical to PR 9; "on" packs
+    # feature rows into fixed-size HBM pages and serves every gather
+    # through the ragged Pallas page-gather kernel.  page_rows=0 sizes
+    # pages automatically (smallest row count whose page is a multiple
+    # of the 512B HBM transaction, >= 4KiB); pool_pages=0 sizes the
+    # OVERLAY page pool off the host-page count (docs/FEATURE_CACHE.md).
+    feature_paged: str = field(
+        default_factory=lambda: _env("FEATURE_PAGED", "off")
+    )
+    feature_page_rows: int = field(
+        default_factory=lambda: _env("FEATURE_PAGE_ROWS", 0, int)
+    )
+    feature_page_pool: int = field(
+        default_factory=lambda: _env("FEATURE_PAGE_POOL", 0, int)
+    )
     # serving
     serving_buckets: Tuple[int, ...] = (
         8, 16, 32, 64, 128, 256, 512, 1024, 2048

@@ -374,6 +374,11 @@ class DistFeature:
                     self._overlay = self._ov_admit_fn(ba)(
                         self._overlay, jnp.asarray(adm_slot),
                         jnp.asarray(rows))
+            row_bytes = (self._host_source.shape[1]
+                         * self._host_source.dtype.itemsize)
+            resident_bytes = cache.resident_bytes(row_bytes)
+        telemetry.gauge("dist_feature_overlay_resident_bytes").set(
+            float(resident_bytes))
         telemetry.counter("dist_feature_coldcache_rows_total",
                           result="hit").inc(float(n_hit))
         telemetry.counter("dist_feature_coldcache_rows_total",
@@ -394,6 +399,9 @@ class DistFeature:
         hit_pos = pos_all[hit_mask]
         valid[me, hit_pos] = False  # hits skip the all-to-all
         bh = _pow2_bucket(n_hit)
+        # bucket-edge discipline (see Feature._stage): the bucket covers
+        # every real hit; padded lanes carry the out-of-range sentinel B
+        assert n_hit <= bh, (n_hit, bh)
         ov_slot = np.zeros(bh, dtype=np.int32)
         ov_slot[:n_hit] = slots[hit_mask]
         ov_pos = np.full(bh, B, dtype=np.int32)

@@ -103,7 +103,7 @@ class Feature:
     """
 
     _guarded_by = {"_pending": "_plock", "_stage_bufs": "_plock",
-                   "_overlay": "_plock",
+                   "_overlay": "_plock", "paged": "_plock",
                    # published table state: writes swap atomically under
                    # _plock; reads are lock-free (double-checked-read
                    # contract shared with QT003/QT008)
@@ -140,6 +140,7 @@ class Feature:
         self.dim = 0
         self.cold_cache = None          # ColdRowCache slot metadata
         self._overlay = None            # jax.Array [C, D] overlay table
+        self.paged = None               # PagedStore (ops/paged.py)
         self._lazy_state = None
         from .recovery.registry import program_cache
 
@@ -223,6 +224,7 @@ class Feature:
             self.cold = cold_np
             self.hot = hot
         self._maybe_enable_cold_cache()
+        self._maybe_enable_paging()
         return self
 
     def _place_hot(self, hot_np, dt):
@@ -273,6 +275,7 @@ class Feature:
             self.dim = arr.shape[1]
             self.hot = self._place_hot(hot_np, hot_np.dtype)
             self._maybe_enable_cold_cache()
+            self._maybe_enable_paging()
             return self
         # budgeted split over the mmap
         self.node_count, self.dim = arr.shape
@@ -286,6 +289,7 @@ class Feature:
         )
         self.cold = arr[cache_count:]
         self._maybe_enable_cold_cache()
+        self._maybe_enable_paging()
         return self
 
     # ------------------------------------------------------------------
@@ -368,6 +372,76 @@ class Feature:
                                       dtype=self._hot_dtype())
         return self
 
+    # -- paged feature store (docs/FEATURE_CACHE.md) -------------------
+    def _maybe_enable_paging(self):
+        """Config-driven paged-store enable at build time
+        (``feature_paged=on``).  Off by default: the staged three-tier
+        merge stays byte-identical — same metric keys, same executable
+        keys — until paging is opted into."""
+        from .config import get_config
+
+        cfg = get_config()
+        if cfg.feature_paged != "on":
+            return
+        if self.cache_count >= self.node_count:
+            return  # fully hot: pure-device gather, nothing to page
+        self.enable_paging(
+            page_rows=cfg.feature_page_rows or None,
+            pool_pages=cfg.feature_page_pool or None)
+
+    def enable_paging(self, page_rows: Optional[int] = None,
+                      pool_pages: Optional[int] = None,
+                      policy: Optional[str] = None) -> "Feature":
+        """Attach the paged store: pack the table into fixed-size HBM
+        pages and serve every budgeted gather through the ragged
+        page-gather kernel (``ops/paged.py``).
+
+        The three tiers become page residency states — the hot prefix
+        is the pinned DEVICE pages, the overlay is the OVERLAY frame
+        pool, the host tail is HOST pages faulted in whole.  The staged
+        merge stays attached underneath as the correctness fallback for
+        batches whose page working set exceeds the pool.
+
+        Args:
+          page_rows: rows per page.  Default: smallest row count whose
+            page is a multiple of the 512B HBM transaction and at least
+            4KiB (``default_page_rows``).
+          pool_pages: OVERLAY pool capacity in pages.  Default: a
+            quarter of the host-page count (min 8), capped at the
+            host-page count.
+          policy: page-table eviction policy, ``"clock"`` | ``"minfreq"``
+            (default from config ``cold_cache_policy``).
+        """
+        from .config import get_config
+        from .ops.paged import PagedStore, PageTable, default_page_rows
+
+        assert self.node_count > 0, (
+            "enable_paging needs a built feature "
+            "(from_cpu_tensor / from_mmap first)")
+        n_cold = self.node_count - self.cache_count
+        if n_cold <= 0:
+            return self  # fully HBM-resident: nothing to page
+        dt = np.dtype(self._hot_dtype())
+        row_bytes = dt.itemsize * self.dim
+        R = int(page_rows) if page_rows else default_page_rows(row_bytes)
+        n_pages = -(-self.node_count // R)
+        hot_pages = -(-self.cache_count // R) if self.cache_count else 0
+        n_host_pages = n_pages - min(hot_pages, n_pages)
+        if pool_pages is None:
+            pool_pages = max(8, n_host_pages // 4)
+        pool_pages = min(int(pool_pages), n_host_pages)
+        policy = policy or self.cold_cache_policy \
+            or get_config().cold_cache_policy
+        table = PageTable(self.node_count, self.cache_count, R,
+                          pool_pages, policy=policy)
+        hot_np = (np.asarray(self.hot) if self.cache_count else None)
+        store = PagedStore(table, self.cold, self.cache_count, self.dim,
+                           dt, hot_host=hot_np)
+        store._feature = self
+        with self._plock:
+            self.paged = store
+        return self
+
     def invalidate_rows(self, node_ids) -> int:
         """Drop mutated rows (OLD node ids) from the cold-row overlay.
 
@@ -383,7 +457,7 @@ class Feature:
         """
         from . import telemetry
 
-        if self.cold_cache is None:
+        if self.cold_cache is None and self.paged is None:
             return 0
         ids = np.atleast_1d(np.asarray(node_ids, dtype=np.int64))
         if self.feature_order is not None:
@@ -395,33 +469,51 @@ class Feature:
             cache = self.cold_cache
             dropped = (cache.invalidate_rows(cold_ids)
                        if cache is not None else 0)
+            if self.paged is not None:
+                # whole OVERLAY pages drop: one stale row poisons its page
+                self.paged.invalidate_rows(cold_ids)
         if dropped:
             telemetry.counter("coldcache_invalidated_rows_total").inc(
                 dropped)
         return dropped
 
     def export_coldcache_state(self) -> Optional[dict]:
-        """Overlay residency/frequency state for a recovery checkpoint
-        (``None`` when no overlay is attached).  Only metadata is
-        exported — the row *values* live in the host cold tier and are
-        re-gathered from it on restore."""
+        """Device-cache residency state for a recovery checkpoint
+        (``None`` when neither overlay nor paged store is attached).
+        Only metadata is exported — the row *values* live in the host
+        cold tier and are re-gathered from it on restore.  With paging
+        on, the page-table residency is exported instead (tagged
+        ``kind="paged"``; the arrays ride the same pinned-dtype
+        serialization as the overlay's)."""
         with self._plock:
+            if self.paged is not None:
+                return self.paged.export_state()
             cache = self.cold_cache
             return cache.export_state() if cache is not None else None
 
     def restore_coldcache_state(self, state: Optional[dict]) -> int:
-        """Re-warm the overlay from a checkpointed state.
+        """Re-warm the overlay (or page table) from a checkpointed state.
 
         Restores the slot metadata, then refills the device table from
         the host cold tier for every resident slot — restoring the map
         without the values would serve zeros for "cached" rows.  The
         geometry must match (``ValueError`` otherwise — the caller
-        starts cold).  Returns the number of rows re-warmed.
+        starts cold).  Kind mismatches degrade cleanly: a paged
+        snapshot restored into a ``feature_paged=off`` build (or vice
+        versa) starts cold instead of refusing boot.  Returns the
+        number of rows re-warmed.
         """
         import jax.numpy as jnp
 
         if state is None:
             return 0
+        if state.get("kind") == "paged":
+            if self.paged is None:
+                return 0  # paging off now: degrade to a cold start
+            with self._plock:
+                return self.paged.restore_state(state)
+        if self.paged is not None and self.cold_cache is None:
+            return 0  # staged snapshot, paged-only build: start cold
         if self.cold_cache is None:
             self.enable_cold_cache(rows=int(state["capacity"]))
         if self.cold_cache is None:
@@ -481,6 +573,11 @@ class Feature:
                 result="hit" if staged is not None else "miss").inc()
         if staged is None:
             staged = self._stage(idx)
+        if staged[0] == "pg":
+            # paged path: ONE ragged-kernel program per batch size (the
+            # inverse-permutation take fuses into it) — the entire
+            # (B, bucket) x ("z"/"patch", bc/bh) grid collapses here
+            return self.paged.finish(staged, self)
         if staged[0] == "ov":
             # additive program structure: base two-way merge keyed by
             # the fresh bucket, then a separate overlay patch keyed by
@@ -548,6 +645,13 @@ class Feature:
         if self.feature_order is not None:
             idx = self.feature_order[idx]
         idx = idx.astype(np.int64)
+        if self.paged is not None and len(idx):
+            with self._plock:
+                st = self.paged.stage(idx, jnp, telemetry)
+            if st is not None:
+                return st
+            # pool overflow: this batch's page working set doesn't fit
+            # the OVERLAY pool — the staged merge below is the fallback
         if self.cold_cache is not None:
             return self._stage_overlay(idx, jax, jnp, telemetry)
         if self.cache_count == 0:
@@ -575,12 +679,18 @@ class Feature:
         if n_cold == 0:
             return ("m", hot_idx, 0, None, None)
         bucket = _pow2_bucket(n_cold)
+        # the bucket must cover every real row — padded lanes beyond
+        # n_cold read only the zero-filled staging tail, never past the
+        # buffer, including when B lands exactly on a bucket edge
+        assert 0 < n_cold <= bucket, (n_cold, bucket)
         rows_d = self._upload_cold(idx[cold_pos] - self.cache_count,
                                    n_cold, bucket, jnp, telemetry)
-        # pad positions with an out-of-range index; the device scatter
-        # drops them (mode="drop")
+        # pad positions with the out-of-range sentinel len(idx) == B;
+        # the device scatter drops them (mode="drop")
         pos = np.full(bucket, len(idx), dtype=np.int32)
         pos[:n_cold] = cold_pos
+        assert (pos[n_cold:] >= len(idx)).all(), \
+            "padding sentinel must stay out of range of the output"
         return ("m", hot_idx, bucket, jnp.asarray(pos), rows_d)
 
     def _upload_cold(self, rel_ids, n_rows, bucket, jnp, telemetry):
@@ -651,6 +761,10 @@ class Feature:
             ov_table = self._overlay  # value consistent with the probe
             bh = _pow2_bucket(n_hit)
             ov_slot_d = ov_pos_d = None
+            # bucket-edge discipline (regression-tested): every bucket
+            # covers its real rows, padded lanes carry the out-of-range
+            # sentinel B and zero-filled buffer tails only
+            assert n_hit <= bh, (n_hit, bh)
             if bh:
                 ov_slot = np.zeros(bh, dtype=np.int32)
                 ov_slot[:n_hit] = slots[hit_mask]
@@ -660,6 +774,7 @@ class Feature:
                 ov_pos_d = jnp.asarray(ov_pos)
             bc = _fresh_bucket(n_fresh)
             rows_d = cold_pos_d = None
+            assert n_fresh <= bc, (n_fresh, bc)
             if bc:
                 fresh_rel = rel[~hit_mask]
                 buf = self._stage_bufs.get(bc)
@@ -779,6 +894,53 @@ class Feature:
                 return table.at[slots].set(rows, mode="drop")
 
             self._merge_cache[("admit", bucket)] = fn
+        return fn
+
+    def _paged_fn(self, B):
+        """ONE cached executable per batch size on the paged path: the
+        ragged page-gather kernel plus the inverse-permutation take that
+        undoes the planner's sort-by-frame.  Keyed ``("paged", B)`` in
+        ``_merge_cache`` — the whole additive bucket grid of the staged
+        path collapses to this single entry (plus the fault scatter's
+        pow2 warmup, ``_paged_fault_fn``)."""
+        import jax
+        import jax.numpy as jnp
+
+        fn = self._merge_cache.get(("paged", B))
+        if fn is None:
+            from .ops.pallas.page_gather_kernel import page_gather
+
+            store = self.paged
+            page_rows = store.table.page_rows
+            block, ppb = store.block, store.ppb
+            interpret = store._interpret
+
+            @jax.jit
+            def fn(frames, blk_pages, blk_np, row_lp, row_off, rank):
+                out = page_gather(
+                    frames, blk_pages, blk_np, row_lp, row_off,
+                    page_rows=page_rows, block=block, ppb=ppb,
+                    interpret=interpret)
+                return jnp.take(out, rank, axis=0)
+
+            self._merge_cache[("paged", B)] = fn
+        return fn
+
+    def _paged_fault_fn(self, k_pad):
+        """Cached scatter writing a pow2-padded batch of faulted pages
+        into the frame pool (pad slot = ``n_frames``, dropped).  The
+        paged analogue of ``_admit_fn`` — no buffer donation: staged
+        plans may still hold the old frames value."""
+        import jax
+
+        fn = self._merge_cache.get(("pgfault", k_pad))
+        if fn is None:
+
+            @jax.jit
+            def fn(frames, slots, pages):
+                return frames.at[slots].set(pages, mode="drop")
+
+            self._merge_cache[("pgfault", k_pad)] = fn
         return fn
 
     # -- async cold-tier prefetch --------------------------------------

@@ -23,6 +23,12 @@ caller holds the owning store's staging lock (``Feature._plock``)
 across probe+admit so the metadata and the captured device table value
 stay consistent (see ``Feature._stage``).
 
+The paged feature store (``ops/paged.py``) reuses this class as its
+**page table**: the "rows" become host pages, the slots become OVERLAY
+frames, and residency/eviction/invalidation/checkpoint export all come
+along unchanged (``admit_threshold=1`` there — a touched HOST page
+must fault in to be served at all).
+
 Policy notes:
 
   * *Second-touch admission* (``admit_threshold=2`` default): a row
@@ -118,7 +124,8 @@ class ColdRowCache:
         return hit, slots
 
     # ------------------------------------------------------------------
-    def admit(self, ids: np.ndarray) -> Tuple[np.ndarray, int]:
+    def admit(self, ids: np.ndarray,
+              protect_slots=None) -> Tuple[np.ndarray, int]:
         """Assign slots to the missed rows that earned admission.
 
         ``ids`` are the missed cold-space ids of one batch (touch counts
@@ -126,13 +133,24 @@ class ColdRowCache:
         where ``slots`` is aligned with ``ids`` (-1 = not admitted;
         duplicates of one id share its slot).  At most ``capacity`` rows
         admit per call; the overflow stays host-served this batch.
+
+        ``protect_slots`` pins already-resident slots against this
+        call's eviction sweep — the paged store passes the batch's
+        OVERLAY-hit pages here, since the gather about to run reads
+        them (evicting a same-batch hit would serve a retargeted page).
+        The count of candidates is clipped so protection can never make
+        the sweep need more victims than the unprotected slots can
+        supply.
         """
         ids = np.asarray(ids, dtype=np.int64)
         out = np.full(len(ids), -1, dtype=np.int32)
         if not len(ids) or self.admission_paused:
             return out, 0
         cand = np.unique(ids[self.touches[ids] >= self.admit_threshold])
-        cand = cand[: self.capacity]
+        n_prot = (len(np.unique(protect_slots))
+                  if protect_slots is not None and len(protect_slots)
+                  else 0)
+        cand = cand[: self.capacity - n_prot]
         k = len(cand)
         if k == 0:
             return out, 0
@@ -147,7 +165,11 @@ class ColdRowCache:
             # protect the slots just taken from the free list: their
             # ref/freq are still zero here, so an unprotected sweep
             # would hand them out twice (two ids sharing one slot)
-            victims = self._evict(k - n_new, protect=slots[:n_new])
+            prot = slots[:n_new]
+            if n_prot:
+                prot = np.concatenate(
+                    [prot, np.asarray(protect_slots, dtype=np.int32)])
+            victims = self._evict(k - n_new, protect=prot)
             slots[n_new:] = victims
             old = self.node_of[victims]
             live = old >= 0
@@ -274,6 +296,12 @@ class ColdRowCache:
     @property
     def resident(self) -> int:
         return int((self.node_of >= 0).sum())
+
+    def resident_bytes(self, row_bytes: int) -> int:
+        """Device bytes the resident entries pin, given the bytes one
+        cached unit occupies (a feature row here; a whole page when the
+        paged store uses this class as its page table)."""
+        return self.resident * int(row_bytes)
 
     def stats(self) -> dict:
         total = self.hits + self.misses

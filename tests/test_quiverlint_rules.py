@@ -210,6 +210,43 @@ class TestRetrace:
         """, name="quiver_tpu/stream/sampler.py")
         assert r.findings == []
 
+    def test_flags_jit_per_page_in_fault_loop(self, tmp_path):
+        # paged-store retrace hazard: building a fresh executable per
+        # faulted page turns every fault batch into a compile storm
+        r = run_lint(tmp_path, """
+            import jax
+
+            class Store:
+                def fault(self, pages, frames):
+                    for p in pages:
+                        frames = jax.jit(
+                            lambda f: f.at[p].set(0))(frames)
+                    return frames
+        """, name="quiver_tpu/ops/paged.py")
+        assert "QT002" in codes(r)
+
+    def test_page_table_as_traced_operand_is_clean(self, tmp_path):
+        # the shipped paged idiom: the gather program is cached on the
+        # batch SIZE; page ids / offsets arrive as traced operands —
+        # never baked into the trace, never a Python-dict key
+        r = run_lint(tmp_path, """
+            import jax
+
+            class Store:
+                def _paged_fn(self, B):
+                    fn = self._cache.get(("paged", B))
+                    if fn is None:
+                        @jax.jit
+                        def fn(frames, pages, offs, rank):
+                            return frames
+                        self._cache[("paged", B)] = fn
+                    return fn
+
+                def gather(self, frames, pages, offs, rank, B):
+                    return self._paged_fn(B)(frames, pages, offs, rank)
+        """, name="quiver_tpu/ops/paged.py")
+        assert r.findings == []
+
 
 # ------------------------------------------------------------ QT003
 class TestLockDiscipline:
