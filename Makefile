@@ -1,7 +1,7 @@
 # Top-level convenience targets (parity: reference ./configure && make).
 .PHONY: all native test test-quick test-native asan bench smoke \
 	telemetry-check chaos stream lint sanitize recovery crash qos \
-	paged help
+	paged timeline perfgate help
 
 all: native
 
@@ -72,5 +72,15 @@ qos:
 paged:
 	python -m pytest tests/ -m paged -q
 
+# unified timeline / program attribution / perfgate suite
+# (docs/OBSERVABILITY.md "Timeline & program attribution")
+timeline:
+	python -m pytest tests/ -m timeline -q
+
+# noise-aware perf-regression gate vs the committed baseline in
+# .bench_state.json (docs/BENCHMARKS.md "Perfgate"); exit 1 = regression
+perfgate:
+	python benchmarks/perfgate.py
+
 help:
-	@echo "targets: native | test | test-quick | test-native | asan | bench | smoke | telemetry-check | chaos | stream | lint | sanitize | recovery | crash | qos | paged | help"
+	@echo "targets: native | test | test-quick | test-native | asan | bench | smoke | telemetry-check | chaos | stream | lint | sanitize | recovery | crash | qos | paged | timeline | perfgate | help"

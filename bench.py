@@ -1547,6 +1547,113 @@ def bench_restart_warm(n_nodes=200_000, n_records=200, batch=1024,
     return out
 
 
+def run_trace_scenario(path):
+    """``bench.py --trace``: one compact run with the unified timeline
+    live across serving, the program registry, the paged feature store,
+    the WAL, chaos injection, and the QoS ladder — exported as ONE
+    Perfetto-loadable Chrome trace at ``path``.
+
+    Self-checking: returns nonzero unless the merged trace carries
+    events from at least five subsystems AND at least one non-serving
+    subsystem shares a trace id with a ``request`` slice (the
+    cross-subsystem correlation the timeline exists for).
+    """
+    import tempfile
+
+    from quiver_tpu import CSRTopo, Feature, telemetry
+    from quiver_tpu.recovery.wal import WriteAheadLog
+    from quiver_tpu.resilience import chaos
+    from quiver_tpu.resilience.qos import DegradationLadder, LadderStep
+    from quiver_tpu.telemetry import flightrec, profile, timeline
+
+    telemetry.set_enabled(True)
+    telemetry.reset()
+    timeline.enable()
+    profile.enable()
+
+    n_nodes, n_edges = 30_000, 400_000
+    indptr, indices = build_graph(n_nodes, n_edges, seed=3)
+    topo = CSRTopo(indptr=indptr, indices=indices)
+    topo.to_device()
+
+    # serving + registry + program attribution: the Device-lane replay.
+    # Telemetry is on, so every request carries a TraceContext (the
+    # correlation origin); warmup compiles land as registry.build
+    # events and every executed program is profile-wrapped.
+    bench_serving(topo, 32, 8, n_requests=12, hidden=64, mode="Device")
+
+    # paged + wal + chaos under ONE explicit trace so their slices
+    # correlate with a request the same way a served mutation would
+    ctx = flightrec.new_trace()
+    rng = np.random.default_rng(5)
+    t_req = time.perf_counter()
+    with flightrec.activate(ctx):
+        # paged feature store: zipf gathers that fault host pages
+        feat = rng.normal(size=(n_nodes, 16)).astype(np.float32)
+        f = Feature(device_cache_size=int(0.2 * n_nodes),
+                    cache_unit="rows").from_cpu_tensor(feat)
+        f.enable_paging(pool_pages=256)
+        p = 1.0 / np.arange(1, n_nodes + 1) ** 0.9
+        p /= p.sum()
+        for _ in range(4):
+            f[rng.choice(n_nodes, size=512, p=p)].block_until_ready()
+
+        # WAL appends under a seeded fsync stall: wal.append/wal.fsync
+        # slices plus chaos.inject instants, same trace id
+        chaos.install(chaos.ChaosPlan(seed=5).delay(
+            "recovery.fsync", 0.001, times=2))
+        try:
+            with tempfile.TemporaryDirectory(prefix="quiver-trace-") as td:
+                wal = WriteAheadLog(os.path.join(td, "wal"),
+                                    fsync="always")
+                for i in range(6):
+                    wal.append(b"trace-op-%d" % i)
+                wal.close()
+        finally:
+            chaos.uninstall()
+    flightrec.get_recorder().finish(
+        ctx, time.perf_counter() - t_req, lane="trace")
+
+    # QoS ladder: one forced down + up transition (ladder ticks come
+    # from the watchdog thread, traceless by design)
+    state = {}
+    ladder = DegradationLadder(
+        [LadderStep(name="trace_demo",
+                    apply=lambda: state.__setitem__("deg", True),
+                    revert=lambda: state.pop("deg", None))],
+        breach_ticks=1, recover_ticks=1)
+    ladder.observe(True)
+    ladder.observe(False)
+
+    timeline.export(path)
+    doc = timeline.chrome_trace()
+    evs = [e for e in doc["traceEvents"] if e.get("ph") != "M"]
+    cats = sorted({e.get("cat") for e in evs})
+    req_ids = {e["args"]["trace_id"] for e in evs
+               if e.get("name") == "request"
+               and e.get("args", {}).get("trace_id")}
+    correlated = sorted({
+        e.get("cat") for e in evs
+        if e.get("args", {}).get("trace_id") in req_ids})
+    top = profile.top_programs(3)
+    log(f"trace: {len(evs)} events, subsystems {cats}, "
+        f"{len(req_ids)} request traces, correlated {correlated}, "
+        f"top programs {[p['subsystem'] + ':' + str(p['key'])[:40] for p in top]}")
+    ok = (len(cats) >= 5 and len(req_ids) > 0
+          and any(c != "serving" for c in correlated))
+    print(json.dumps({
+        "trace_path": path, "events": len(evs), "subsystems": cats,
+        "request_traces": len(req_ids),
+        "correlated_subsystems": correlated,
+        "programs_attributed": profile.debug_payload()["programs"],
+        "ok": ok,
+    }))
+    if not ok:
+        log("trace: FAILED acceptance (need >=5 subsystems and a "
+            "non-serving subsystem correlated with a request trace)")
+    return 0 if ok else 1
+
+
 # ---------------------------------------------------------------- main
 def main():
     ap = argparse.ArgumentParser()
@@ -1566,7 +1673,43 @@ def main():
                     help="ignore .bench_state.json resume state")
     ap.add_argument("--gather-mode", default=None,
                     help="skip the probe and use this mode")
+    ap.add_argument("--trace", nargs="?", const="timeline_trace.json",
+                    default=None, metavar="PATH",
+                    help="run the compact cross-subsystem timeline "
+                         "scenario and export a Perfetto-loadable "
+                         "Chrome trace to PATH, then exit")
+    ap.add_argument("--check", action="store_true",
+                    help="run the noise-aware perf gate "
+                         "(benchmarks/perfgate.py) and exit with its "
+                         "verdict: 0 pass/seeded, 1 regression")
+    ap.add_argument("--xla-trace", default=None, metavar="DIR",
+                    help="wrap the run in the XLA profiler "
+                         "(tensorboard-viewable; best effort — "
+                         "degrades to a no-op if unavailable)")
     args = ap.parse_args()
+
+    if args.check:
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "benchmarks"))
+        from perfgate import main as perfgate_main
+
+        sys.exit(perfgate_main([]))
+
+    if args.xla_trace:
+        # entered here, stopped at process exit: the profiler must wrap
+        # whichever path below runs, and profile_trace is hardened to
+        # no-op (warn once) when the profiler can't start
+        import atexit
+
+        from quiver_tpu.utils.trace import profile_trace
+
+        _xla_span = profile_trace(args.xla_trace)
+        _xla_span.__enter__()
+        atexit.register(lambda: _xla_span.__exit__(None, None, None))
+
+    if args.trace is not None:
+        sys.exit(run_trace_scenario(args.trace))
+
     want = set(args.sections.split(","))
 
     if args.small:

@@ -16,13 +16,14 @@ from .qt008_races import DataRaceRule
 from .qt009_lock_order import LockOrderRule
 from .qt010_thread_reap import ThreadReapRule
 from .qt011_durability import DurabilityRule
+from .qt012_wall_clock import WallClockRule
 
 __all__ = ["all_rules", "RULE_CLASSES"]
 
 RULE_CLASSES = (HostSyncRule, RetraceRule, LockDisciplineRule,
                 ImportLayeringRule, HygieneRule, MetricNameRule,
                 SilentExceptRule, DataRaceRule, LockOrderRule,
-                ThreadReapRule, DurabilityRule)
+                ThreadReapRule, DurabilityRule, WallClockRule)
 
 
 def all_rules() -> List[Rule]:

@@ -236,6 +236,27 @@ class Config:
     )
     # tracing
     trace: bool = field(default_factory=lambda: _env("TRACE", False, bool))
+    # unified timeline (telemetry.timeline): per-thread ring capacity in
+    # events — a thread past capacity overwrites its own oldest events
+    # (export reports the overwrite count), so a traced soak run is
+    # bounded at threads x capacity x ~100B no matter how long it runs
+    timeline_ring_capacity: int = field(
+        default_factory=lambda: _env("TIMELINE_RING_CAPACITY", 8192, int)
+    )
+    # perf-regression gate (benchmarks/perfgate.py): repeats per metric
+    # (the gate compares medians-of-k), the MAD multiplier above which a
+    # slowdown counts as signal, and the relative-change floor below
+    # which even a statistically-clear slowdown is ignored as too small
+    # to gate on
+    perfgate_k: int = field(
+        default_factory=lambda: _env("PERFGATE_K", 5, int)
+    )
+    perfgate_mad_mult: float = field(
+        default_factory=lambda: _env("PERFGATE_MAD_MULT", 5.0, float)
+    )
+    perfgate_rel_floor: float = field(
+        default_factory=lambda: _env("PERFGATE_REL_FLOOR", 0.30, float)
+    )
 
 
 _config: Optional[Config] = None

@@ -242,10 +242,17 @@ class PagedStore:
                 float(n_evicted))
         telemetry.gauge("feature_page_resident_bytes").set(
             float(self.table.resident_pages() * self.page_bytes))
-        from ..telemetry import flightrec
+        from ..telemetry import flightrec, timeline
 
         if flightrec.tracing():
+            # forwards to the unified timeline too, trace-correlated
             flightrec.event("feature.page_fault", {
+                "pages": k, "evicted": int(n_evicted),
+                "h2d_bytes": int(h2d_bytes)})
+        elif timeline._ON:
+            # faults from untraced gathers (warmup, loader prefetch)
+            # still belong on the timeline
+            timeline.emit("feature.page_fault", cat="paged", attrs={
                 "pages": k, "evicted": int(n_evicted),
                 "h2d_bytes": int(h2d_bytes)})
         return k

@@ -37,6 +37,8 @@ import weakref
 from typing import Dict, Optional
 
 from .. import telemetry
+from ..telemetry import profile as _profile
+from ..telemetry import timeline as _timeline
 from .errors import RetraceBudgetExceeded
 
 __all__ = ["ProgramCache", "ProgramRegistry", "get_program_registry",
@@ -72,6 +74,8 @@ class ProgramCache(dict):
         return present
 
     def __setitem__(self, key, value) -> None:
+        if _profile._ON:   # one global read when profiling is off
+            value = _profile.wrap(self.subsystem, key, value)
         fresh = not dict.__contains__(self, key)
         dict.__setitem__(self, key, value)
         if fresh:
@@ -146,6 +150,10 @@ class ProgramRegistry:
                     st["post_seal_builds"] > budget
         telemetry.counter("registry_builds_total",
                           subsystem=subsystem).inc()
+        if _timeline._ON:  # one global read when the timeline is off
+            _timeline.emit("registry.build", cat="registry",
+                           attrs={"subsystem": subsystem,
+                                  "post_seal": bool(sealed)})
         if sealed:
             telemetry.counter("registry_retraces_post_seal_total",
                               subsystem=subsystem).inc()

@@ -56,11 +56,13 @@ import os
 import re
 import struct
 import threading
+import time
 from typing import Iterator, List, Optional, Tuple
 
 import numpy as np
 
 from .. import telemetry
+from ..telemetry import timeline as _timeline
 from ..resilience import chaos
 from . import blockio
 from .errors import WALError, WALWriteError
@@ -218,6 +220,7 @@ class WriteAheadLog:
         (including chaos faults) — the record must then be treated as
         NOT durable and the submitting request answered with the error.
         """
+        t0 = time.perf_counter() if _timeline._ON else 0.0
         with self._lock:
             if self._closed:
                 raise WALWriteError("append on closed WAL")
@@ -241,6 +244,10 @@ class WriteAheadLog:
                 raise WALWriteError(f"wal append failed: {e}") from e
         telemetry.counter("recovery_wal_records_total").inc()
         telemetry.counter("recovery_wal_bytes_total").inc(float(n))
+        if _timeline._ON and t0:
+            _timeline.emit("wal.append", cat="wal",
+                           dur_s=time.perf_counter() - t0,
+                           attrs={"lsn": lsn, "bytes": int(n)})
         return lsn
 
     def sync(self) -> None:
@@ -269,10 +276,14 @@ class WriteAheadLog:
             # promises no fsync, so an injected fsync fault has nothing
             # real to stand in for there
             if self.fsync_policy != "off":
+                t0 = time.perf_counter() if _timeline._ON else 0.0
                 _CHAOS_FSYNC()
                 self._f.flush()
                 os.fsync(self._f.fileno())
                 telemetry.counter("recovery_wal_fsyncs_total").inc()
+                if _timeline._ON and t0:
+                    _timeline.emit("wal.fsync", cat="wal",
+                                   dur_s=time.perf_counter() - t0)
             self._unsynced = 0
 
     def _roll_locked(self) -> None:

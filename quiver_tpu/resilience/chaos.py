@@ -143,8 +143,13 @@ class ChaosPlan:
             self._log.append((name, idx, action))
         if action != "pass":
             from .. import telemetry
+            from ..telemetry import timeline
 
             telemetry.counter("chaos_injections_total", point=name).inc()
+            if timeline._ON:
+                timeline.emit("chaos.inject", cat="chaos",
+                              attrs={"point": name, "hit": idx,
+                                     "action": action})
         if delay_s:
             time.sleep(delay_s)
         if exc is not None:

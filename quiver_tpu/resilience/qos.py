@@ -48,6 +48,7 @@ from typing import Callable, Dict, List, Optional
 
 from .. import telemetry
 from ..telemetry import flightrec
+from ..telemetry import timeline as _timeline
 from .deadline import shed
 from .errors import QuotaExceeded
 
@@ -352,9 +353,16 @@ class DegradationLadder:
         telemetry.counter("serving_qos_ladder_transitions_total",
                           direction=direction, step=step.name).inc()
         if flightrec.tracing():
+            # forwards to the unified timeline too, trace-correlated
             flightrec.event("qos.ladder", {"direction": direction,
                                            "step": step.name,
                                            "level": new_level})
+        elif _timeline._ON:
+            # ladder ticks usually come from the watchdog thread with
+            # no request trace active — land them on the timeline anyway
+            _timeline.emit("qos.ladder", cat="qos",
+                           attrs={"direction": direction,
+                                  "step": step.name, "level": new_level})
         with self._lock:
             self._history.append({"t_wall": time.time(),
                                   "direction": direction,

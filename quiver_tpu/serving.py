@@ -47,6 +47,7 @@ from .resilience.lanes import BoundedLane, WeightedFairLane
 from .resilience.qos import qos_from_config
 from .resilience.shutdown import join_and_reap
 from .telemetry import flightrec
+from .telemetry import timeline as _timeline
 
 __all__ = [
     "RequestBatcher", "HybridSampler", "InferenceServer",
@@ -102,6 +103,14 @@ class ServingRequest:
                                            "seq": self.seq})
         if self.trace is not None and self.tenant is not None:
             self.trace.tenant = self.tenant
+        if self.trace is not None and _timeline._ON:
+            # the admission instant anchors this request's trace_id on
+            # the unified timeline; stage slices and the final
+            # "request" span (recorder.finish) share it
+            _timeline.emit("request.enqueue", cat="serving",
+                           attrs={"n_ids": int(len(self.ids)),
+                                  "client": self.client},
+                           trace=self.trace)
 
     def expired(self, now: Optional[float] = None) -> bool:
         if self.deadline is None:

@@ -16,8 +16,11 @@ Three views:
     surface: ``/debug/requests`` (retained-request summaries),
     ``/debug/requests/<trace_id>`` (one full event log), ``/debug/slo``
     (watchdog objective status), ``/debug/breakers`` (per-lane
-    circuit-breaker states), and ``/debug/qos`` (tenant classes, token
-    levels, degradation-ladder level + history).  ``/healthz`` reports
+    circuit-breaker states), ``/debug/qos`` (tenant classes, token
+    levels, degradation-ladder level + history), ``/debug/timeline``
+    (the unified cross-subsystem Chrome trace — Perfetto-loadable),
+    and ``/debug/programs`` (top-K per-program time attribution, see
+    ``telemetry.profile``).  ``/healthz`` reports
     the recovery
     readiness ladder (200 only when ``serving``; 503 while
     booting/replaying/warming — see docs/RECOVERY.md).  ``HEAD``
@@ -37,13 +40,24 @@ __all__ = ["to_prometheus_text", "to_json", "MetricsServer",
            "start_http_server"]
 
 
+def _escape_label_value(v) -> str:
+    # Prometheus text format: label VALUES escape backslash, double
+    # quote, and line feed (in that order — escaping the escapes first
+    # keeps the round trip unambiguous).  Unescaped, a hostile tenant
+    # name like `gold"} 1\n` splits the sample line and corrupts the
+    # whole exposition.
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
 def _fmt_labels(labels: dict, extra: Optional[dict] = None) -> str:
     merged = dict(labels)
     if extra:
         merged.update(extra)
     if not merged:
         return ""
-    inner = ",".join(f'{k}="{merged[k]}"' for k in sorted(merged))
+    inner = ",".join(f'{k}="{_escape_label_value(merged[k])}"'
+                     for k in sorted(merged))
     return "{" + inner + "}"
 
 
@@ -180,6 +194,18 @@ class MetricsServer:
                     from ..resilience.qos import qos_status
 
                     return (json.dumps(qos_status(), indent=2),
+                            "application/json")
+                if path.startswith("/debug/timeline"):
+                    from . import timeline
+
+                    # the merged Chrome trace itself: save the body,
+                    # load it in Perfetto (docs/OBSERVABILITY.md)
+                    return (json.dumps(timeline.chrome_trace()),
+                            "application/json")
+                if path.startswith("/debug/programs"):
+                    from . import profile
+
+                    return (json.dumps(profile.debug_payload(), indent=2),
                             "application/json")
                 return None
 

@@ -45,6 +45,8 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from . import timeline as _timeline
+
 __all__ = [
     "TraceContext", "FlightRecorder",
     "new_trace", "current", "active", "activate", "event", "flag",
@@ -272,6 +274,11 @@ def event(name: str, attrs: Optional[dict] = None) -> None:
         return
     for c in ctxs:
         c.add(name, attrs)
+    if _timeline._ON:  # one global read when the timeline is off
+        # a {"seconds": dt} attr is a stage interval that just closed:
+        # surface it as a complete slice, anything else as an instant
+        dur = attrs.get("seconds") if attrs else None
+        _timeline.emit(name, dur_s=dur, attrs=attrs, trace=ctxs[0])
 
 
 def flag() -> None:
@@ -336,6 +343,12 @@ class FlightRecorder:
             return None
         from . import counter
 
+        if _timeline._ON:  # one global read when the timeline is off
+            # the request's end-to-end slice IS the correlation origin:
+            # every stage event sharing its trace_id nests under it
+            _timeline.emit("request", cat="serving", dur_s=e2e_seconds,
+                           attrs={"status": status, "lane": lane},
+                           trace=ctx)
         reason = self.classify(ctx, e2e_seconds, status)
         if reason is None:
             counter("flightrec_dropped_total").inc()

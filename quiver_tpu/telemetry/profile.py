@@ -1,0 +1,207 @@
+"""Per-program time attribution over the unified AOT program registry.
+
+Every executable in the library lives in a
+:class:`~quiver_tpu.recovery.registry.ProgramCache`.  When profiling is
+enabled, cache insertions (and, retroactively, existing entries) are
+wrapped in a :class:`_ProfiledProgram` that records, per call:
+
+  * **host seconds** — dispatch wall time (the python call returning);
+  * **total seconds** — dispatch + ``jax.block_until_ready`` on the
+    result, i.e. device execution for a jitted program;
+  * an honest ``device`` flag — False when the backend is CPU, so a
+    rehearsal run can never masquerade as silicon attribution
+    (docs/BENCHMARKS.md honesty rules).
+
+Aggregates land in ``program_time_seconds{subsystem=...}`` histograms
+and a per-(subsystem, key) table served at ``GET /debug/programs``
+(:func:`top_programs`).  Each call also lands on the unified timeline
+(:mod:`.timeline`) as a complete slice when that is recording.
+
+The wrapper forwards attribute access to the wrapped callable, so
+owners that poke at jit internals (``fn.lower``, ``_fun``) keep
+working; ``unwrap`` restores the raw program.  Blocking on the result
+serializes async dispatch — that is the point (attribution needs the
+device time), and why this is opt-in rather than always-on.
+
+Gating: same discipline as :mod:`.timeline` — ``on()`` is one module
+global; disabled, the registry's ``__setitem__`` pays exactly one
+global read.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+__all__ = ["on", "enable", "disable", "reset", "wrap", "unwrap",
+           "record", "top_programs", "stats", "debug_payload"]
+
+_ON = False
+
+_LOCK = threading.Lock()
+# (subsystem, key-repr) -> [calls, host_s, total_s, device_calls]
+_STATS: Dict[tuple, List[float]] = {}
+_guarded_by = {"_STATS": "_LOCK"}
+
+
+def on() -> bool:
+    """True iff program profiling is recording — one global read."""
+    return _ON
+
+
+class _ProfiledProgram:
+    """Callable shim: forwards to the wrapped program, attributing each
+    call's host + block-until-ready time to (subsystem, key)."""
+
+    __slots__ = ("__wrapped__", "_subsystem", "_key")
+
+    def __init__(self, fn, subsystem: str, key):
+        object.__setattr__(self, "__wrapped__", fn)
+        object.__setattr__(self, "_subsystem", subsystem)
+        object.__setattr__(self, "_key", key)
+
+    def __call__(self, *args, **kwargs):
+        import time
+
+        fn = self.__wrapped__
+        if not _ON:                    # profiling stopped after wrap
+            return fn(*args, **kwargs)
+        t0 = time.perf_counter()
+        out = fn(*args, **kwargs)
+        host_s = time.perf_counter() - t0
+        device = False
+        try:
+            import jax
+
+            jax.block_until_ready(out)
+            device = jax.default_backend() != "cpu"
+        except Exception:
+            pass                       # non-jax result: host time is all
+        total_s = time.perf_counter() - t0
+        record(self._subsystem, self._key, host_s, total_s, device)
+        return out
+
+    def __getattr__(self, name):
+        return getattr(object.__getattribute__(self, "__wrapped__"), name)
+
+    def __repr__(self):
+        return (f"_ProfiledProgram({self._subsystem}[{self._key!r}]: "
+                f"{self.__wrapped__!r})")
+
+
+def wrap(subsystem: str, key, fn):
+    """Wrap ``fn`` for attribution (idempotent; non-callables pass
+    through untouched — a cache may hold tuples of aux data)."""
+    if not callable(fn) or isinstance(fn, _ProfiledProgram):
+        return fn
+    return _ProfiledProgram(fn, subsystem, key)
+
+
+def unwrap(fn):
+    return getattr(fn, "__wrapped__", fn)
+
+
+def record(subsystem: str, key, host_s: float, total_s: float,
+           device: bool) -> None:
+    """Fold one call into the table + histogram + timeline."""
+    k = (subsystem, repr(key))
+    with _LOCK:
+        st = _STATS.get(k)
+        if st is None:
+            _STATS[k] = [1, host_s, total_s, 1 if device else 0]
+        else:
+            st[0] += 1
+            st[1] += host_s
+            st[2] += total_s
+            st[3] += 1 if device else 0
+    from . import histogram
+    from . import timeline
+
+    histogram("program_time_seconds", subsystem=subsystem).observe(total_s)
+    if timeline._ON:
+        timeline.emit(f"program.{subsystem}", cat="registry", dur_s=total_s,
+                      attrs={"key": repr(key), "device": device,
+                             "host_s": round(host_s, 6)})
+
+
+def _iter_live_caches():
+    import sys
+
+    # never instantiate the program registry just to (un)wrap it: if
+    # the module was never imported there is nothing to profile
+    mod = sys.modules.get("quiver_tpu.recovery.registry")
+    if mod is None:
+        return []
+    reg = mod.get_program_registry()
+    with reg._lock:
+        pairs = [(sub, ref()) for sub, ref in reg._caches]
+    return [(sub, c) for sub, c in pairs if c is not None]
+
+
+def enable() -> bool:
+    """Start attribution.  Retro-wraps every live cache's existing
+    programs (bypassing the seal gate — wrapping is not a build), so a
+    warmed server can be profiled without recompiling anything.
+    Returns False when telemetry is disabled."""
+    global _ON
+    from . import enabled
+
+    if not enabled():
+        return False
+    # quiverlint: ignore[QT008] -- single atomic bool rebind; the
+    # registry's __setitem__ tolerates one stale observation (one
+    # unwrapped program, caught by the retro-wrap below)
+    _ON = True
+    for sub, cache in _iter_live_caches():
+        for key in list(cache.keys()):
+            v = dict.__getitem__(cache, key)
+            dict.__setitem__(cache, key, wrap(sub, key, v))
+    return True
+
+
+def disable() -> None:
+    """Stop attribution and unwrap every live cache entry."""
+    global _ON
+    # quiverlint: ignore[QT008] -- single atomic bool rebind, see enable
+    _ON = False
+    for _sub, cache in _iter_live_caches():
+        for key in list(cache.keys()):
+            v = dict.__getitem__(cache, key)
+            dict.__setitem__(cache, key, unwrap(v))
+
+
+def reset() -> None:
+    disable()
+    with _LOCK:
+        _STATS.clear()
+
+
+def stats() -> Dict[tuple, List[float]]:
+    with _LOCK:
+        return {k: list(v) for k, v in _STATS.items()}
+
+
+def top_programs(k: int = 20) -> List[dict]:
+    """Top-K programs by total attributed seconds (the
+    ``GET /debug/programs`` table)."""
+    rows = []
+    for (sub, key), (calls, host_s, total_s, dev_calls) in stats().items():
+        calls = int(calls)
+        rows.append({
+            "subsystem": sub,
+            "key": key,
+            "calls": calls,
+            "host_s": round(host_s, 6),
+            "total_s": round(total_s, 6),
+            "mean_ms": round(total_s / calls * 1e3, 4) if calls else 0.0,
+            # honest stamping: True only if EVERY call ran on a
+            # non-CPU backend — mixed runs read as not-device
+            "device": bool(calls) and int(dev_calls) == calls,
+        })
+    rows.sort(key=lambda r: r["total_s"], reverse=True)
+    return rows[:max(int(k), 0)]
+
+
+def debug_payload(k: int = 20) -> dict:
+    return {"enabled": _ON, "top": top_programs(k),
+            "programs": len(stats())}

@@ -26,6 +26,8 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from . import timeline as _timeline
+
 __all__ = ["SpanTracer", "Span"]
 
 _MAX_EVENTS = 200_000  # retention cap: ~25 MB of events, then drop
@@ -103,6 +105,9 @@ class SpanTracer:
                     })
                 else:
                     self._dropped += 1
+        if _timeline._ON:  # one global read when the timeline is off
+            _timeline.emit(name, cat="span", dur_s=dt, t0=t0,
+                           attrs={"depth": depth} if depth else None)
 
     # -- switches ---------------------------------------------------------
     @property

@@ -85,16 +85,46 @@ def reset_trace():
     _tracer().reset()
 
 
+_PROFILE_WARNED = False
+
+
+def _warn_profile_once(msg: str):
+    global _PROFILE_WARNED
+    if not _PROFILE_WARNED:
+        _PROFILE_WARNED = True
+        import sys
+
+        print(f"[quiver_tpu] {msg}", file=sys.stderr)
+
+
 @contextlib.contextmanager
 def profile_trace(log_dir: str):
-    """XLA-level profiler span (tensorboard-viewable)."""
-    import jax
+    """XLA-level profiler span (tensorboard-viewable).
 
-    jax.profiler.start_trace(log_dir)
+    Best effort: when the profiler cannot start (no ``jax.profiler``,
+    another trace already live, unwritable ``log_dir``) the span
+    degrades to a no-op with ONE stderr warning per process —
+    a perf-investigation flag must never take the workload down.
+    ``stop_trace`` is only called for a trace this span started.
+    """
+    started = False
+    jax = None
+    try:
+        import jax
+
+        jax.profiler.start_trace(log_dir)
+        started = True
+    except Exception as e:
+        _warn_profile_once(
+            f"XLA profiler unavailable ({e!r}); profile_trace is a no-op")
     try:
         yield
     finally:
-        jax.profiler.stop_trace()
+        if started:
+            try:
+                jax.profiler.stop_trace()
+            except Exception as e:
+                _warn_profile_once(f"XLA profiler stop failed ({e!r})")
 
 
 def show_tensor_info(t, name: str = "tensor", printer=print):

@@ -124,6 +124,11 @@ def test_stale_baseline_entry_fails_only_under_strict(tmp_path):
      "\n\ndef _sneaky_sidecar(path):\n"
      "    with open(path, \"w\") as f:\n"
      "        f.write(\"unframed, unchecksummed\")\n"),
+    ("quiver_tpu/serving.py", "QT012",
+     "\n\ndef _wall_timed(fn):\n"
+     "    t0 = time.time()\n"
+     "    fn()\n"
+     "    return time.time() - t0\n"),
 ])
 def test_injected_violation_fails_cli(tmp_path, relpath, code, appended):
     root = _repo_copy_with(tmp_path, relpath, appended)

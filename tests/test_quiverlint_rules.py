@@ -780,6 +780,79 @@ class TestDurability:
         assert r.findings == []
 
 
+# ------------------------------------------------------------ QT012
+class TestWallClock:
+    def test_flags_direct_wall_clock_subtraction(self, tmp_path):
+        r = run_lint(tmp_path, """
+            import time
+
+            def serve(fn):
+                t0 = time.time()
+                fn()
+                return time.time() - t0
+        """, hot_modules=ALL_HOT)
+        assert codes(r) == ["QT012"]
+        assert "perf_counter" in r.findings[0].message
+
+    def test_flags_subtraction_through_assigned_names(self, tmp_path):
+        r = run_lint(tmp_path, """
+            import time
+
+            def serve(fn):
+                start = time.time()
+                fn()
+                now = time.time()
+                return (now - start) * 1e3
+        """, hot_modules=ALL_HOT)
+        assert codes(r) == ["QT012"]
+
+    def test_flags_bare_time_import(self, tmp_path):
+        r = run_lint(tmp_path, """
+            from time import time
+
+            def serve(fn):
+                t0 = time()
+                fn()
+                return time() - t0
+        """, hot_modules=ALL_HOT)
+        assert codes(r) == ["QT012"]
+
+    def test_perf_counter_is_clean(self, tmp_path):
+        r = run_lint(tmp_path, """
+            import time
+
+            def serve(fn):
+                t0 = time.perf_counter()
+                fn()
+                return time.perf_counter() - t0
+        """, hot_modules=ALL_HOT)
+        assert r.findings == []
+
+    def test_timestamp_and_deadline_uses_are_clean(self, tmp_path):
+        # wall-clock TIMESTAMPS are fine: record fields, absolute
+        # deadlines built by addition, threshold comparisons
+        r = run_lint(tmp_path, """
+            import time
+
+            def audit(history, timeout):
+                history.append({"t_wall": time.time()})
+                deadline = time.time() + timeout
+                return time.time() > deadline
+        """, hot_modules=ALL_HOT)
+        assert r.findings == []
+
+    def test_cold_module_is_clean(self, tmp_path):
+        r = run_lint(tmp_path, """
+            import time
+
+            def offline_report(fn):
+                t0 = time.time()
+                fn()
+                return time.time() - t0
+        """, hot_modules=("nothing/*.py",))
+        assert r.findings == []
+
+
 # ------------------------------------------------------------ CLI
 class TestCli:
     def test_exit_codes_and_baseline_flow(self, tmp_path, capsys):
