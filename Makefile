@@ -1,7 +1,7 @@
 # Top-level convenience targets (parity: reference ./configure && make).
 .PHONY: all native test test-quick test-native asan bench smoke \
 	telemetry-check chaos stream lint sanitize recovery crash qos \
-	paged timeline perfgate help
+	paged timeline perfgate fleet fleet-chaos help
 
 all: native
 
@@ -82,5 +82,16 @@ timeline:
 perfgate:
 	python benchmarks/perfgate.py
 
+# elastic replicated serving fleet suite: router, membership, WAL
+# shipping edge cases, drain/rejoin (docs/FLEET.md)
+fleet:
+	python -m pytest tests/ -m fleet -q
+
+# replica-failover chaos harness: 3 real replica processes, kill -9 one
+# mid-burst, prove zero lost answers + warm rejoin (docs/FLEET.md)
+fleet-chaos:
+	python -m pytest tests/ -m fleet -q
+	python benchmarks/fleet_chaos.py --smoke
+
 help:
-	@echo "targets: native | test | test-quick | test-native | asan | bench | smoke | telemetry-check | chaos | stream | lint | sanitize | recovery | crash | qos | paged | timeline | perfgate | help"
+	@echo "targets: native | test | test-quick | test-native | asan | bench | smoke | telemetry-check | chaos | stream | lint | sanitize | recovery | crash | qos | paged | timeline | perfgate | fleet | fleet-chaos | help"

@@ -217,11 +217,24 @@ class _SectionRunner:
             with open(STATE_PATH + ".lock", "w") as lk:
                 fcntl.flock(lk, fcntl.LOCK_EX)
                 try:
+                    # the file is shared with perfgate's committed
+                    # baselines (top-level "perfgate" key): carry every
+                    # foreign top-level key through the rewrite
+                    try:
+                        raw = json.load(open(STATE_PATH))
+                        if not isinstance(raw, dict):
+                            raw = {}
+                    except Exception:
+                        raw = {}
                     disk = _load_all_states()
                     disk[self.fp] = self.state
+                    raw.pop("fp", None)       # legacy single-fp layout
+                    raw.pop("sections", None)
+                    raw.pop("attempts", None)
+                    raw.update({"version": 2, "states": disk})
                     tmp = STATE_PATH + ".tmp"
                     with open(tmp, "w") as fh:
-                        json.dump({"version": 2, "states": disk}, fh)
+                        json.dump(raw, fh)
                     os.replace(tmp, STATE_PATH)
                 finally:
                     fcntl.flock(lk, fcntl.LOCK_UN)
@@ -1547,6 +1560,49 @@ def bench_restart_warm(n_nodes=200_000, n_records=200, batch=1024,
     return out
 
 
+def bench_fleet_chaos():
+    """Replica-failover chaos proof (``benchmarks/fleet_chaos.py``):
+    3 real replica processes behind the fleet router, ``kill -9`` of
+    one follower mid-burst, warm rejoin through the shared caches.
+
+    The committed facts are the loss/rejoin invariants (zero lost
+    answers, SIGKILL confirmed, pcache hits on rejoin, staleness back
+    under bound) — backend-independent.  The latency numbers are a CPU
+    rehearsal off-TPU and are stamped as such; the headline never
+    quotes them as device truth.
+    """
+    import jax
+
+    from benchmarks.fleet_chaos import check, run_fleet_chaos
+
+    rep = run_fleet_chaos(smoke=True, seed=0)
+    fo, rj = rep["failover"], rep["rejoin"]
+    out = {
+        "backend": rep["backend"],
+        "phases": rep["phases"],
+        "lost_answers": rep["lost_answers"],
+        "kill_returncode": fo.get("kill_returncode"),
+        "redispatches": fo.get("redispatches"),
+        "p99_ratio_burst_vs_baseline":
+            fo.get("p99_ratio_burst_vs_baseline"),
+        "p99_ratio_cool_vs_baseline":
+            fo.get("p99_ratio_cool_vs_baseline"),
+        "rejoin_seconds": rj.get("rejoin_seconds"),
+        "rejoin_pcache_hits": rj.get("pcache_hits"),
+        "rejoin_new_cache_files": rj.get("new_cache_files"),
+        "staleness_lsn_final": rj.get("staleness_lsn_final"),
+        "failures": check(rep),
+    }
+    if jax.default_backend() != "tpu":
+        out["source"] = "cpu_rehearsal"
+    log(f"fleet_chaos: {rep['lost_answers']} lost answers, "
+        f"kill rc {fo.get('kill_returncode')}, "
+        f"p99 ratio {fo.get('p99_ratio_burst_vs_baseline')}, "
+        f"rejoin {rj.get('rejoin_seconds')}s "
+        f"(pcache hits {rj.get('pcache_hits')})")
+    return out
+
+
 def run_trace_scenario(path):
     """``bench.py --trace``: one compact run with the unified timeline
     live across serving, the program registry, the paged feature store,
@@ -1665,7 +1721,8 @@ def main():
                             "feature_paged,e2e,"
                             "serving,serving_flightrec,"
                             "serving_resilience,serving_qos,"
-                            "stream_ingest,restart_warm,quality",
+                            "stream_ingest,restart_warm,fleet_chaos,"
+                            "quality",
                     help="comma-separated subset to run")
     ap.add_argument("--ab-dedup", action="store_true",
                     help="also measure dedup='hop' for sampling + e2e")
@@ -1904,6 +1961,8 @@ def main():
                    lambda: bench_restart_warm(
                        n_nodes=50_000 if args.small else 200_000,
                        n_records=50 if args.small else 200))
+    if "fleet_chaos" in want:
+        runner.run("fleet_chaos", 900, bench_fleet_chaos)
 
     if "sampling" in want:
         if args.gather_mode or args.small:

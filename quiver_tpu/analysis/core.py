@@ -96,6 +96,7 @@ _DEFAULT_HOT = (
     "quiver_tpu/resilience/*.py",
     "quiver_tpu/stream/*.py",
     "quiver_tpu/recovery/*.py",
+    "quiver_tpu/fleet/*.py",
 )
 
 

@@ -11,8 +11,9 @@ every ``telemetry.counter/gauge/histogram`` call:
   * the metric name is a **literal** ``snake_case`` string (never an
     f-string, concatenation, or variable);
   * the name carries a unit suffix: ``_total`` (counts), ``_seconds``
-    (durations), ``_bytes`` (sizes), ``_state`` (enum gauges), or
-    ``_level`` (ordinal gauges — the QoS degradation ladder);
+    (durations), ``_bytes`` (sizes), ``_state`` (enum gauges),
+    ``_level`` (ordinal gauges — the QoS degradation ladder), or
+    ``_lsn`` (log-sequence-number watermarks — WAL shipping lag);
   * label keys are literal keyword arguments — ``**labels`` expansion
     hides the key set from static inspection and is flagged.
 
@@ -33,7 +34,8 @@ from typing import Iterator, Set
 from ..core import Finding, ModuleContext, Rule, dotted_call_name
 
 _FACTORIES = {"counter", "gauge", "histogram"}
-_UNIT_SUFFIXES = ("_total", "_seconds", "_bytes", "_state", "_level")
+_UNIT_SUFFIXES = ("_total", "_seconds", "_bytes", "_state", "_level",
+                  "_lsn")
 _SNAKE = re.compile(r"^[a-z][a-z0-9_]*$")
 # factory kwargs that are API options, not metric labels
 _OPTION_KWARGS = {"bounds", "help"}

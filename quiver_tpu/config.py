@@ -257,6 +257,48 @@ class Config:
     perfgate_rel_floor: float = field(
         default_factory=lambda: _env("PERFGATE_REL_FLOOR", 0.30, float)
     )
+    # replicated serving fleet (quiver_tpu/fleet, docs/FLEET.md):
+    # shared membership-directory path, placement shape (partitions /
+    # virtual nodes on the consistent-hash ring), liveness cadence,
+    # router re-dispatch budget, the QoS priority at or above which a
+    # tenant routes power-of-two-choices, per-dispatch timeout, WAL
+    # shipping poll/holdback cadence, and the staleness bound (in WAL
+    # records) above which a follower should not be considered current
+    fleet_dir: str = field(
+        default_factory=lambda: _env("FLEET_DIR", "", str)
+    )
+    fleet_partitions: int = field(
+        default_factory=lambda: _env("FLEET_PARTITIONS", 8, int)
+    )
+    fleet_vnodes: int = field(
+        default_factory=lambda: _env("FLEET_VNODES", 64, int)
+    )
+    fleet_heartbeat_s: float = field(
+        default_factory=lambda: _env("FLEET_HEARTBEAT_S", 0.5, float)
+    )
+    fleet_heartbeat_timeout_s: float = field(
+        default_factory=lambda: _env("FLEET_HEARTBEAT_TIMEOUT_S", 3.0,
+                                     float)
+    )
+    fleet_route_retries: int = field(
+        default_factory=lambda: _env("FLEET_ROUTE_RETRIES", 2, int)
+    )
+    fleet_hot_priority: int = field(
+        default_factory=lambda: _env("FLEET_HOT_PRIORITY", 3, int)
+    )
+    fleet_request_timeout_s: float = field(
+        default_factory=lambda: _env("FLEET_REQUEST_TIMEOUT_S", 1.0,
+                                     float)
+    )
+    fleet_ship_poll_ms: float = field(
+        default_factory=lambda: _env("FLEET_SHIP_POLL_MS", 20.0, float)
+    )
+    fleet_ship_grace_ms: float = field(
+        default_factory=lambda: _env("FLEET_SHIP_GRACE_MS", 250.0, float)
+    )
+    fleet_max_staleness_lsn: int = field(
+        default_factory=lambda: _env("FLEET_MAX_STALENESS_LSN", 1024, int)
+    )
 
 
 _config: Optional[Config] = None

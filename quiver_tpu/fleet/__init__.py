@@ -1,0 +1,27 @@
+"""Elastic replicated serving fleet (docs/FLEET.md).
+
+Turns the single-node subsystems into a deployable system: a thin
+partition-aware router in front of N replicas that warm-boot from
+shared checkpoints + the JAX persistent compilation cache, tail the
+single-writer WAL for reads, and survive ``kill -9`` mid-burst without
+losing an in-flight request (``benchmarks/fleet_chaos.py``).
+
+  * :mod:`~quiver_tpu.fleet.membership` — shared file-based replica
+    directory (atomic-rename records, heartbeat freshness);
+  * :mod:`~quiver_tpu.fleet.shipping` — read-only WAL follower with a
+    measured, bounded staleness watermark;
+  * :mod:`~quiver_tpu.fleet.replica` — replica lifecycle: warm join,
+    heartbeats, TCP serving endpoint, drain/rejoin;
+  * :mod:`~quiver_tpu.fleet.router` — consistent-hash routing, health
+    gating, per-replica breakers, bounded re-dispatch.
+"""
+
+from .membership import FLEET_STATES, MembershipDirectory, ReplicaInfo
+from .replica import FleetReplica
+from .router import ConsistentHashRing, FleetRouter, fleet_status
+from .shipping import WALFollower
+
+__all__ = [
+    "FLEET_STATES", "MembershipDirectory", "ReplicaInfo", "FleetReplica",
+    "ConsistentHashRing", "FleetRouter", "fleet_status", "WALFollower",
+]

@@ -1,0 +1,462 @@
+"""Fleet replica lifecycle: warm join, serve, drain, rejoin.
+
+One :class:`FleetReplica` is one serving process in the fleet.  Two
+roles share the same lifecycle and differ only in how state arrives:
+
+  * **leader** (exactly one) — owns the WAL.  Boots through
+    :class:`~quiver_tpu.recovery.manager.RecoveryManager` (checkpoint
+    restore + tail replay), attaches an
+    :class:`~quiver_tpu.stream.ingest.IngestLane` so every write is
+    durable-before-ack, and runs the periodic checkpointer that lets
+    followers resync and the log truncate.
+  * **follower** (N) — read replica.  Restores the newest *shared*
+    checkpoint, then tails the leader's WAL through
+    :class:`~quiver_tpu.fleet.shipping.WALFollower`; never opens the
+    log for writing.
+
+Both climb the same readiness ladder the single-node tier defined
+(``booting → replaying → warming → serving``) and announce every rung
+into the shared :class:`~quiver_tpu.fleet.membership.
+MembershipDirectory`, so the router's view of "who can take traffic"
+is the same contract ``/healthz`` sells.  The join path IS the PR 8
+warm-boot path: with ``config.recovery_cache_dir`` set, a joining
+replica enables the JAX persistent compilation cache before building
+anything, runs its warmup against cached executables, and can ``seal``
+its program registry — a rejoin that recompiles is a loud budget
+violation, not a silent p99 cliff.
+
+Serving transport is a deliberately small TCP JSON-lines protocol
+(stdlib ``socketserver``; the metrics/health HTTP endpoint stays in
+``telemetry.export``): one JSON object per line in, one per line out,
+multiple requests per connection.  Answers are ``status: ok``, a typed
+``shed`` (still an *answer* — the router never re-dispatches it), or
+``unavailable`` (booting/draining — the router treats it as a
+transport failure and re-dispatches).  Drain is explicit: announce
+``draining``, refuse new admissions, finish in-flight requests,
+deregister, stop — the inverse of join, and chaos-tested in
+``benchmarks/fleet_chaos.py``.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import socketserver
+import threading
+import time
+from typing import Callable, Optional
+
+from .. import telemetry
+from ..recovery.checkpoint import load_checkpoint, restore_graph
+from ..recovery.errors import RecoveryError
+from ..resilience import chaos
+from ..resilience.errors import DeadlineExceeded, LoadShed, QuotaExceeded
+from .membership import FLEET_STATES, MembershipDirectory, ReplicaInfo
+from .shipping import WALFollower
+
+__all__ = ["FleetReplica"]
+
+log = logging.getLogger("quiver_tpu.fleet")
+
+_CHAOS_JOIN = chaos.point("fleet.join")
+
+# typed sheds cross the wire as answers; everything else is an error
+_SHED_TYPES = (LoadShed, DeadlineExceeded, QuotaExceeded)
+
+
+class _ReplicaTCPServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class FleetReplica:
+    """One fleet member: boot → announce → serve → drain/stop."""
+
+    _guarded_by = {
+        "_state": "_lock", "_stale": "_lock", "_inflight": "_lock",
+        "_draining": "_lock", "_boot_seconds": "_lock",
+        "manager": "_lock", "graph": "_lock", "follower": "_lock",
+        "_server": "_lock", "metrics_server": "_lock",
+    }
+
+    def __init__(self, replica_id: str, fleet_dir: Optional[str] = None,
+                 root: Optional[str] = None,
+                 graph_factory: Optional[Callable] = None,
+                 role: str = "follower", host: str = "127.0.0.1",
+                 port: int = 0,
+                 service_fn: Optional[Callable] = None,
+                 heartbeat_s: Optional[float] = None,
+                 warmup: Optional[Callable] = None, seal: bool = False,
+                 catchup_timeout_s: float = 30.0):
+        from ..config import get_config
+
+        cfg = get_config()
+        if role not in ("leader", "follower"):
+            raise ValueError(f"role must be leader|follower, got {role!r}")
+        self.replica_id = str(replica_id)
+        self.role = role
+        fleet_dir = str(fleet_dir if fleet_dir is not None
+                        else cfg.fleet_dir)
+        if not fleet_dir:
+            raise RecoveryError(
+                "no fleet directory: pass fleet_dir= or set "
+                "QUIVER_TPU_FLEET_DIR / config.fleet_dir")
+        root = str(root if root is not None else cfg.recovery_dir)
+        if not root:
+            raise RecoveryError(
+                "no durability root: pass root= or set "
+                "QUIVER_TPU_RECOVERY_DIR / config.recovery_dir")
+        self.root = root
+        self.wal_dir = os.path.join(root, "wal")
+        self.ckpt_dir = os.path.join(root, "ckpt")
+        self.directory = MembershipDirectory(fleet_dir)
+        self.graph_factory = graph_factory
+        self.host = host
+        self._requested_port = int(port)
+        self.service_fn = service_fn
+        self.warmup = warmup
+        self.seal = bool(seal)
+        self.catchup_timeout_s = float(catchup_timeout_s)
+        self.heartbeat_s = float(heartbeat_s if heartbeat_s is not None
+                                 else cfg.fleet_heartbeat_s)
+        self.graph = None
+        self.manager = None           # leader only (RecoveryManager)
+        self.lane = None              # leader only (IngestLane)
+        self.follower: Optional[WALFollower] = None  # follower only
+        self.metrics_server = None
+        self._lock = threading.Lock()
+        self._state = "booting"
+        self._stale = True
+        self._inflight = 0
+        self._draining = False
+        self._boot_seconds: Optional[float] = None
+        self._server: Optional[_ReplicaTCPServer] = None
+        self._server_thread: Optional[threading.Thread] = None
+        self._hb_stop = threading.Event()
+        self._hb_thread: Optional[threading.Thread] = None
+
+    # -- readiness ladder ---------------------------------------------
+    def _set_state(self, state: str, stale: Optional[bool] = None) -> None:
+        assert state in FLEET_STATES
+        with self._lock:
+            self._state = state
+            if stale is not None:
+                self._stale = stale
+        self._announce()
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    def health(self) -> dict:
+        """Per-replica ``/healthz`` document (instance-scoped, NOT the
+        process-global recovery view — N replicas on one host each
+        report their own ladder)."""
+        with self._lock:
+            state, stale = self._state, self._stale
+        out = {
+            "replica_id": self.replica_id,
+            "role": self.role,
+            "state": state,
+            "ready": state == "serving",
+            "stale": stale,
+            "managed": True,
+        }
+        if self.graph is not None:
+            out["graph_version"] = int(self.graph.version)
+        if self._boot_seconds is not None:
+            out["boot_seconds"] = round(self._boot_seconds, 3)
+        if self.follower is not None:
+            st = self.follower.status()
+            out["staleness_lsn"] = st["staleness_lsn"]
+            out["staleness_seconds"] = st["staleness_seconds"]
+            out["applied_lsn"] = st["applied_lsn"]
+        if self.manager is not None and self.manager.wal is not None:
+            out["wal_next_lsn"] = self.manager.wal.next_lsn
+        return out
+
+    # -- boot ----------------------------------------------------------
+    def boot(self) -> "FleetReplica":
+        """Join the fleet: warm-boot state, reach ``serving``, open the
+        TCP endpoint, start heartbeats."""
+        _CHAOS_JOIN()
+        t0 = time.perf_counter()
+        if self.role == "leader":
+            self._boot_leader()
+        else:
+            self._boot_follower()
+        self._start_server()
+        boot_seconds = time.perf_counter() - t0
+        with self._lock:
+            self._boot_seconds = boot_seconds
+        telemetry.gauge("fleet_join_seconds",
+                        replica=self.replica_id).set(boot_seconds)
+        self._set_state("serving", stale=False)
+        self._start_heartbeat()
+        return self
+
+    def _boot_leader(self) -> None:
+        from ..recovery.manager import RecoveryManager
+        from ..stream import IngestLane
+
+        self._set_state("booting", stale=True)
+        with self._lock:
+            self.manager = RecoveryManager(
+                self.root, graph_factory=self.graph_factory)
+        with self._lock:
+            self.graph = self.manager.boot_degraded()
+        self._set_state("replaying", stale=True)
+        self.manager.finish_boot(warmup=self.warmup, seal=self.seal)
+        self.lane = IngestLane(self.graph).start()
+        self.manager.attach_lane(self.lane)
+        self._set_state("warming", stale=False)
+
+    def _boot_follower(self) -> None:
+        from ..config import get_config
+
+        cfg = get_config()
+        self._set_state("booting", stale=True)
+        if cfg.recovery_cache_dir:
+            # the PR 8 warm-boot path IS the fleet join path: compiled
+            # programs come off the shared disk cache, not the compiler
+            from ..recovery.registry import get_program_registry
+
+            get_program_registry().enable_persistent_cache(
+                cfg.recovery_cache_dir)
+        start_lsn = self._restore_from_checkpoint()
+        self._set_state("replaying", stale=True)
+        with self._lock:
+            self.follower = WALFollower(
+                self.wal_dir, apply_fn=self._apply_shipped,
+                start_lsn=start_lsn,
+                resync_fn=self._resync_from_checkpoint,
+                name=self.replica_id).start()
+        self._await_catchup()
+        self._set_state("warming", stale=False)
+        if self.warmup is not None:
+            self.warmup(self.graph)
+        if self.seal:
+            from ..recovery.registry import get_program_registry
+
+            get_program_registry().seal()
+
+    def _restore_from_checkpoint(self) -> int:
+        """Restore the newest shared checkpoint into ``self.graph``;
+        returns its WAL watermark (-1 for a fresh factory graph)."""
+        ckpt = load_checkpoint(self.ckpt_dir)
+        if ckpt is not None:
+            with self._lock:
+                self.graph = restore_graph(ckpt)
+            log.info("replica %s restored checkpoint %s (version %d, "
+                     "watermark %d)", self.replica_id, ckpt.path,
+                     ckpt.graph_version, ckpt.wal_lsn)
+            return ckpt.wal_lsn
+        if self.graph_factory is None:
+            raise RecoveryError(
+                f"no checkpoint under {self.ckpt_dir} and no "
+                "graph_factory to build a fresh follower graph from")
+        with self._lock:
+            self.graph = self.graph_factory()
+        return -1
+
+    def _resync_from_checkpoint(self) -> int:
+        """WALFollower strand handler: rebuild follower state from the
+        newest shared checkpoint; returns the next LSN to tail from."""
+        watermark = self._restore_from_checkpoint()
+        return watermark + 1
+
+    def _apply_shipped(self, lsn: int, op, src, dst, ts) -> None:
+        from ..stream.compactor import compact
+
+        graph = self.graph
+        if op == "add":
+            try:
+                graph.add_edges(src, dst, ts if graph.has_ts else None)
+            except BufferError:
+                compact(graph)
+                graph.add_edges(src, dst, ts if graph.has_ts else None)
+        elif op == "remove":
+            graph.remove_edges(src, dst)
+
+    def _await_catchup(self) -> None:
+        """Block until the follower has folded in everything visible
+        (staleness 0) — the join equivalent of ``finish_boot`` replay."""
+        deadline = time.monotonic() + self.catchup_timeout_s
+        while time.monotonic() < deadline:
+            st = self.follower.status()
+            if st["records"] >= 0 and st["staleness_lsn"] == 0 \
+                    and st["last_error"] is None:
+                # one caught-up observation after at least one poll ran
+                return
+            time.sleep(min(self.follower.poll_interval_s, 0.05))
+        raise RecoveryError(
+            f"replica {self.replica_id} not caught up within "
+            f"{self.catchup_timeout_s}s: {self.follower.status()}")
+
+    # -- serving endpoint ---------------------------------------------
+    def _start_server(self) -> None:
+        outer = self
+
+        class _Handler(socketserver.StreamRequestHandler):
+            def handle(self):
+                while True:
+                    line = self.rfile.readline()
+                    if not line:
+                        return
+                    resp = outer._serve_line(line)
+                    self.wfile.write(
+                        (json.dumps(resp) + "\n").encode())
+
+        with self._lock:
+            self._server = _ReplicaTCPServer(
+                (self.host, self._requested_port), _Handler)
+        self._server_thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name=f"quiver-fleet-replica-{self.replica_id}")
+        self._server_thread.start()
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1] if self._server else 0
+
+    def _serve_line(self, line: bytes) -> dict:
+        try:
+            req = json.loads(line)
+        except ValueError:
+            return {"status": "error", "error": "BadRequest",
+                    "reason": "unparsable request line"}
+        with self._lock:
+            admitted = self._state == "serving" and not self._draining
+            if admitted:
+                self._inflight += 1
+        if not admitted:
+            return {"status": "unavailable", "state": self.state,
+                    "replica": self.replica_id}
+        t0 = time.perf_counter()
+        try:
+            out = self._service(req.get("ids", ()), req.get("tenant"))
+            out.setdefault("status", "ok")
+        except _SHED_TYPES as e:
+            # a typed shed is an ANSWER — the router must not retry it
+            out = {"status": "shed", "error": type(e).__name__,
+                   "reason": str(e)}
+        except Exception as e:
+            out = {"status": "error", "error": type(e).__name__,
+                   "reason": str(e)}
+        finally:
+            with self._lock:
+                self._inflight -= 1
+        out["replica"] = self.replica_id
+        out["latency_ms"] = round((time.perf_counter() - t0) * 1e3, 3)
+        if "seq" in req:
+            out["seq"] = req["seq"]
+        return out
+
+    def _service(self, ids, tenant) -> dict:
+        if self.service_fn is not None:
+            return dict(self.service_fn(ids, tenant))
+        # default service: a versioned read touch — enough for routing,
+        # membership, and chaos proofs; real deployments pass a
+        # service_fn that drives their sampler/feature pipeline
+        return {"n": len(ids),
+                "version": int(self.graph.version)
+                if self.graph is not None else -1}
+
+    # -- metrics / health endpoint ------------------------------------
+    def expose_metrics(self, port: int = 0):
+        """Per-replica ``/metrics`` + ``/healthz`` on an ephemeral port
+        (N replicas on one host never collide)."""
+        # local import: telemetry.export pulls in http.server (QT004)
+        from ..telemetry.export import MetricsServer
+
+        server = MetricsServer(port=port, health_fn=self.health)
+        with self._lock:
+            self.metrics_server = server
+        return server
+
+    # -- membership / heartbeat ---------------------------------------
+    def _info(self) -> ReplicaInfo:
+        health = self.health()
+        return ReplicaInfo(
+            replica_id=self.replica_id, state=self.state, host=self.host,
+            port=self.port, role=self.role, pid=os.getpid(),
+            staleness_lsn=int(health.get("staleness_lsn", 0)),
+            staleness_seconds=float(health.get("staleness_seconds", 0.0)),
+            wal_next_lsn=int(health.get("wal_next_lsn", -1)),
+            detail={"metrics_port":
+                    self.metrics_server.port if self.metrics_server
+                    else 0},
+        )
+
+    def _announce(self) -> None:
+        try:
+            self.directory.announce(self._info())
+        except OSError as e:
+            # a missed heartbeat ages us out of routing; log, don't die
+            log.warning("replica %s announce failed: %s",
+                        self.replica_id, e)
+
+    def _start_heartbeat(self) -> None:
+        self._hb_stop.clear()
+
+        def _beat():
+            while not self._hb_stop.wait(self.heartbeat_s):
+                self._announce()
+
+        self._hb_thread = threading.Thread(
+            target=_beat, daemon=True,
+            name=f"quiver-fleet-hb-{self.replica_id}")
+        self._hb_thread.start()
+
+    # -- drain / stop --------------------------------------------------
+    def drain(self, timeout: float = 30.0) -> None:
+        """Graceful exit: stop admitting, finish in-flight, deregister.
+
+        After drain the replica can :meth:`stop` (full shutdown) — or a
+        fresh process can rejoin under the same id (warm, through the
+        shared caches)."""
+        with self._lock:
+            self._draining = True
+        self._set_state("draining")
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                if self._inflight == 0:
+                    break
+            time.sleep(0.01)
+        self.directory.deregister(self.replica_id)
+
+    def stop(self, timeout: float = 5.0) -> None:
+        from ..resilience.shutdown import join_and_reap
+
+        self._hb_stop.set()
+        threads = []
+        if self._hb_thread is not None:
+            threads.append(self._hb_thread)
+            self._hb_thread = None
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            if self._server_thread is not None:
+                threads.append(self._server_thread)
+                self._server_thread = None
+            with self._lock:
+                self._server = None
+        if threads:
+            join_and_reap(threads, timeout, component="fleet.replica")
+        if self.follower is not None:
+            self.follower.stop(timeout)
+        if self.lane is not None:
+            self.lane.stop(timeout)
+            self.lane = None
+        if self.manager is not None:
+            self.manager.close()
+            with self._lock:
+                self.manager = None
+        if self.metrics_server is not None:
+            self.metrics_server.close()
+            with self._lock:
+                self.metrics_server = None
+        self.directory.deregister(self.replica_id)

@@ -1,0 +1,413 @@
+"""Partition-aware fleet router: hash, health-gate, dispatch, re-dispatch.
+
+The router is the thin tier in front of the replica fleet.  It holds no
+graph state — only three views it can rebuild at any moment:
+
+  * **membership** — the shared directory's fresh ``serving`` records
+    (:class:`~quiver_tpu.fleet.membership.MembershipDirectory`);
+  * **health** — each replica's ``/healthz`` readiness document, polled
+    on a cadence and cached, so a wedged process whose heartbeat file
+    is still fresh ages out of routing anyway;
+  * **breakers** — one :class:`~quiver_tpu.resilience.breaker.
+    CircuitBreaker` per replica, so a replica that eats requests
+    (connect timeout, garbage reply) stops receiving them after
+    ``failure_threshold`` strikes and is re-probed half-open.
+
+Placement is consistent hashing over *partitions*, not raw ids: the
+partition of a request is ``ids[0] % config.fleet_partitions`` (the
+locality-partition shape GNNSampler argues for — requests for the same
+neighbourhood hit the same replica's warm caches), and the ring only
+reshuffles ``1/N`` of partitions when a replica joins or leaves.  Hot
+tenants (QoS class priority ≥ ``config.fleet_hot_priority``) use
+power-of-two-choices between the partition's top two preference-list
+replicas, trading a little cache affinity for not letting one replica
+melt under a zipfian head key.
+
+The failure contract is the fleet-wide version of "answered, never
+dropped": a transport failure or an ``unavailable`` reply re-dispatches
+the request to the next replica on the preference list (bounded by
+``config.fleet_route_retries``, backoff between attempts); a typed
+``shed`` reply is an **answer** and is returned as-is — retrying a shed
+would defeat admission control.  When the budget is exhausted the
+caller gets a typed :class:`~quiver_tpu.resilience.errors.
+NoReplicaAvailable`, and ``fleet_router_unroutable_total`` ticks.
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+import json
+import logging
+import socket
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+from weakref import ref as weakref
+
+from .. import telemetry
+from ..resilience import chaos
+from ..resilience.breaker import get_breaker
+from ..resilience.errors import NoReplicaAvailable
+from ..resilience.retry import Backoff
+from .membership import MembershipDirectory, ReplicaInfo
+
+__all__ = ["ConsistentHashRing", "FleetRouter", "fleet_status"]
+
+log = logging.getLogger("quiver_tpu.fleet")
+
+_CHAOS_ROUTE = chaos.point("fleet.route")
+
+
+def _hash(key: str) -> int:
+    return int.from_bytes(
+        hashlib.blake2b(key.encode(), digest_size=8).digest(), "big")
+
+
+class ConsistentHashRing:
+    """Consistent hashing with virtual nodes.
+
+    Deterministic across processes (blake2b, no PYTHONHASHSEED
+    dependence): every router instance over the same membership set
+    computes the same partition → replica preference lists.
+    """
+
+    def __init__(self, vnodes: Optional[int] = None):
+        from ..config import get_config
+
+        self.vnodes = int(vnodes if vnodes is not None
+                          else get_config().fleet_vnodes)
+        if self.vnodes < 1:
+            raise ValueError(f"vnodes must be >= 1, got {self.vnodes}")
+        self._members: tuple = ()
+        self._points: List[int] = []
+        self._owners: List[str] = []
+
+    @property
+    def members(self) -> tuple:
+        return self._members
+
+    def set_members(self, members: Sequence[str]) -> None:
+        members = tuple(sorted(set(members)))
+        if members == self._members:
+            return
+        ring = []
+        for m in members:
+            for v in range(self.vnodes):
+                ring.append((_hash(f"{m}#{v}"), m))
+        ring.sort()
+        self._members = members
+        self._points = [p for p, _ in ring]
+        self._owners = [m for _, m in ring]
+
+    def preference(self, key, n: Optional[int] = None) -> List[str]:
+        """The first ``n`` *distinct* members clockwise of ``key`` —
+        the dispatch order for that partition."""
+        if not self._members:
+            return []
+        n = len(self._members) if n is None else min(n, len(self._members))
+        i = bisect.bisect(self._points, _hash(str(key))) % len(self._points)
+        out: List[str] = []
+        for step in range(len(self._points)):
+            owner = self._owners[(i + step) % len(self._points)]
+            if owner not in out:
+                out.append(owner)
+                if len(out) >= n:
+                    break
+        return out
+
+
+class FleetRouter:
+    """Routes serving requests into the fleet; owns no graph state."""
+
+    _guarded_by = {
+        "_eligible": "_lock", "_health_ok": "_lock", "_inflight": "_lock",
+        "_last_scan": "_lock",
+    }
+
+    def __init__(self, directory: MembershipDirectory,
+                 partitions: Optional[int] = None,
+                 vnodes: Optional[int] = None,
+                 route_retries: Optional[int] = None,
+                 request_timeout_s: Optional[float] = None,
+                 hot_priority: Optional[int] = None,
+                 health_poll_s: float = 0.25,
+                 scan_ttl_s: float = 0.1,
+                 backoff: Optional[Backoff] = None):
+        from ..config import get_config
+
+        cfg = get_config()
+        self.directory = directory
+        self.partitions = int(partitions if partitions is not None
+                              else cfg.fleet_partitions)
+        self.route_retries = int(route_retries if route_retries is not None
+                                 else cfg.fleet_route_retries)
+        self.request_timeout_s = float(
+            request_timeout_s if request_timeout_s is not None
+            else cfg.fleet_request_timeout_s)
+        self.hot_priority = int(hot_priority if hot_priority is not None
+                                else cfg.fleet_hot_priority)
+        self.health_poll_s = float(health_poll_s)
+        self.scan_ttl_s = float(scan_ttl_s)
+        self.backoff = backoff if backoff is not None else Backoff(
+            base_s=0.005, cap_s=0.1, jitter=0.2)
+        self.ring = ConsistentHashRing(vnodes)
+        self._lock = threading.Lock()
+        self._eligible: Dict[str, ReplicaInfo] = {}
+        self._health_ok: Dict[str, bool] = {}
+        self._inflight: Dict[str, int] = {}
+        self._last_scan = 0.0
+        self._hp_stop = threading.Event()
+        self._hp_thread: Optional[threading.Thread] = None
+        _set_active(self)
+
+    # -- fleet view ----------------------------------------------------
+    def refresh(self, force: bool = False) -> None:
+        """Re-scan membership (rate-limited by ``scan_ttl_s``) and
+        rebuild the routable set: fresh + ``serving`` + health-gated +
+        breaker-admitted candidates enter the hash ring."""
+        now = time.monotonic()
+        with self._lock:
+            if not force and (now - self._last_scan) < self.scan_ttl_s:
+                return
+            self._last_scan = now
+        fresh = {r.replica_id: r
+                 for r in self.directory.replicas(fresh_only=True)
+                 if r.state == "serving"}
+        with self._lock:
+            health = dict(self._health_ok)
+        eligible = {rid: r for rid, r in fresh.items()
+                    if health.get(rid, True)}
+        with self._lock:
+            self._eligible = eligible
+        self.ring.set_members(eligible.keys())
+        telemetry.gauge("fleet_router_eligible_total").set(
+            float(len(eligible)))
+
+    def _poll_health_once(self) -> None:
+        # QT004 keeps http.server out of library modules; the CLIENT
+        # side (urllib) is fine and is how the router consumes the
+        # ladder each replica's MetricsServer already sells
+        import urllib.request
+
+        with self._lock:
+            targets = [(r.replica_id, r.host,
+                        int(r.detail.get("metrics_port", 0)))
+                       for r in self._eligible.values()]
+        for rid, host, mport in targets:
+            if mport <= 0:
+                continue  # no health endpoint: membership state governs
+            ok = False
+            try:
+                with urllib.request.urlopen(
+                        f"http://{host}:{mport}/healthz",
+                        timeout=self.request_timeout_s) as resp:
+                    ok = resp.status == 200 and bool(
+                        json.loads(resp.read()).get("ready"))
+            except (OSError, ValueError):
+                ok = False
+            with self._lock:
+                self._health_ok[rid] = ok
+
+    def start_health_poller(self) -> "FleetRouter":
+        """Background ``/healthz`` poll loop (optional — tests may call
+        :meth:`_poll_health_once` deterministically instead)."""
+
+        def _loop():
+            while not self._hp_stop.wait(self.health_poll_s):
+                try:
+                    self._poll_health_once()
+                except Exception as e:
+                    # the poller must outlive flaky replicas; a failed
+                    # sweep leaves the previous health view in place
+                    log.warning("fleet health poll failed: %s", e)
+
+        self._hp_stop.clear()
+        self._hp_thread = threading.Thread(
+            target=_loop, daemon=True, name="quiver-fleet-health")
+        self._hp_thread.start()
+        return self
+
+    # -- placement -----------------------------------------------------
+    def partition_of(self, ids) -> int:
+        try:
+            first = int(ids[0])
+        except (IndexError, TypeError, ValueError):
+            first = 0
+        return first % self.partitions
+
+    def _is_hot(self, tenant: Optional[str]) -> bool:
+        if tenant is None:
+            return False
+        from ..resilience.qos import get_qos
+
+        controller = get_qos()
+        if controller is None:
+            return False
+        klass = controller.resolve(tenant)
+        return klass is not None and klass.priority >= self.hot_priority
+
+    def candidates(self, partition: int,
+                   tenant: Optional[str] = None) -> List[str]:
+        """Dispatch order for a partition.  Hot tenants use power-of-
+        two-choices between the top two preferred replicas (least
+        in-flight wins) so a zipfian head key cannot melt one replica;
+        everyone else gets plain preference order (cache affinity)."""
+        prefs = self.ring.preference(partition)
+        if len(prefs) >= 2 and self._is_hot(tenant):
+            with self._lock:
+                a, b = (self._inflight.get(prefs[0], 0),
+                        self._inflight.get(prefs[1], 0))
+            if b < a:
+                prefs[0], prefs[1] = prefs[1], prefs[0]
+        return prefs
+
+    # -- dispatch ------------------------------------------------------
+    def request(self, ids, tenant: Optional[str] = None,
+                seq: Optional[int] = None,
+                sleep: Callable[[float], None] = time.sleep) -> dict:
+        """Route one serving request; returns the replica's reply dict.
+
+        Transport failures and ``unavailable`` replies re-dispatch to
+        the next candidate (bounded); ``ok``/``shed``/``error`` replies
+        are answers and return immediately.  Raises
+        :class:`NoReplicaAvailable` when the budget is exhausted —
+        never returns silence.
+        """
+        _CHAOS_ROUTE()
+        self.refresh()
+        partition = self.partition_of(ids)
+        prefs = self.candidates(partition, tenant)
+        budget = 1 + max(self.route_retries, 0)
+        req = {"ids": list(map(int, ids)), "tenant": tenant}
+        if seq is not None:
+            req["seq"] = seq
+        attempts = 0
+        for attempt in range(budget):
+            if attempt >= 1:
+                # the fleet may have changed under us (that is the
+                # point of re-dispatch) — rebuild the candidate list
+                self.refresh(force=True)
+                prefs = self.candidates(partition, tenant)
+            target = self._pick(prefs)
+            if target is None:
+                break
+            attempts += 1
+            reply = self._dispatch(target, req)
+            if reply is not None:
+                telemetry.counter("fleet_router_requests_total",
+                                  replica=target,
+                                  status=reply.get("status", "ok")).inc()
+                return reply
+            # transport-level failure: the request is still ours to
+            # answer — re-dispatch after a short breather
+            telemetry.counter("fleet_router_redispatch_total",
+                              replica=target).inc()
+            prefs = [p for p in prefs if p != target]
+            if attempt + 1 < budget:
+                sleep(self.backoff.delay(attempt))
+        telemetry.counter("fleet_router_unroutable_total").inc()
+        raise NoReplicaAvailable(partition, attempts)
+
+    def _pick(self, prefs: List[str]) -> Optional[str]:
+        for rid in prefs:
+            if get_breaker(f"fleet.{rid}").allow():
+                return rid
+        return None
+
+    def _dispatch(self, replica_id: str, req: dict) -> Optional[dict]:
+        """One attempt against one replica.  Returns the reply dict, or
+        None for a transport-level failure / ``unavailable`` (both mean
+        "try another replica")."""
+        with self._lock:
+            info = self._eligible.get(replica_id)
+            self._inflight[replica_id] = \
+                self._inflight.get(replica_id, 0) + 1
+        breaker = get_breaker(f"fleet.{replica_id}")
+        try:
+            if info is None:
+                raise OSError(f"replica {replica_id} left the fleet")
+            with socket.create_connection(
+                    (info.host, info.port),
+                    timeout=self.request_timeout_s) as conn:
+                conn.sendall((json.dumps(req) + "\n").encode())
+                with conn.makefile("rb") as f:
+                    line = f.readline()
+            if not line:
+                raise OSError(f"replica {replica_id} closed mid-request")
+            reply = json.loads(line)
+            if reply.get("status") == "unavailable":
+                # honest refusal (booting/draining): not a strike worth
+                # a full breaker trip, but not an answer either
+                breaker.record_failure()
+                return None
+            breaker.record_success()
+            return reply
+        except (OSError, ValueError):
+            breaker.record_failure()
+            with self._lock:
+                self._health_ok[replica_id] = False
+            return None
+        finally:
+            with self._lock:
+                self._inflight[replica_id] -= 1
+
+    # -- introspection -------------------------------------------------
+    def status(self) -> dict:
+        """JSON view for ``/debug/fleet``."""
+        from ..resilience.breaker import breakers_status
+
+        with self._lock:
+            eligible = sorted(self._eligible)
+            inflight = dict(self._inflight)
+            health = dict(self._health_ok)
+        return {
+            "partitions": self.partitions,
+            "route_retries": self.route_retries,
+            "eligible": eligible,
+            "ring_members": list(self.ring.members),
+            "inflight": inflight,
+            "health_ok": health,
+            "breakers": {name: st for name, st in
+                         breakers_status().items()
+                         if name.startswith("fleet.")},
+            "membership": self.directory.status(),
+        }
+
+    def close(self, timeout: float = 5.0) -> None:
+        from ..resilience.shutdown import join_and_reap
+
+        self._hp_stop.set()
+        if self._hp_thread is not None:
+            join_and_reap([self._hp_thread], timeout,
+                          component="fleet.route")
+            self._hp_thread = None
+        _clear_active(self)
+
+
+# -- /debug/fleet plumbing (weakref, same pattern as recovery.manager) --
+_ACTIVE_LOCK = threading.Lock()
+_ACTIVE: Optional[Callable] = None
+
+
+def _set_active(router: FleetRouter) -> None:
+    global _ACTIVE
+    with _ACTIVE_LOCK:
+        _ACTIVE = weakref(router)
+
+
+def _clear_active(router: FleetRouter) -> None:
+    global _ACTIVE
+    with _ACTIVE_LOCK:
+        if _ACTIVE is not None and _ACTIVE() is router:
+            _ACTIVE = None
+
+
+def fleet_status() -> dict:
+    """Status of the most recently constructed router in this process
+    (the ``/debug/fleet`` document); ``{"active": False}`` when none."""
+    with _ACTIVE_LOCK:
+        router = _ACTIVE() if _ACTIVE is not None else None
+    if router is None:
+        return {"active": False}
+    return dict(router.status(), active=True)

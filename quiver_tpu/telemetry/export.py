@@ -19,11 +19,14 @@ Three views:
     circuit-breaker states), ``/debug/qos`` (tenant classes, token
     levels, degradation-ladder level + history), ``/debug/timeline``
     (the unified cross-subsystem Chrome trace — Perfetto-loadable),
-    and ``/debug/programs`` (top-K per-program time attribution, see
-    ``telemetry.profile``).  ``/healthz`` reports
-    the recovery
+    ``/debug/programs`` (top-K per-program time attribution, see
+    ``telemetry.profile``), and ``/debug/fleet`` (router + membership
+    view of the replicated serving fleet, see docs/FLEET.md).
+    ``/healthz`` reports the recovery
     readiness ladder (200 only when ``serving``; 503 while
-    booting/replaying/warming — see docs/RECOVERY.md).  ``HEAD``
+    booting/replaying/warming — see docs/RECOVERY.md); with
+    ``health_fn=`` the document is instance-scoped (one fleet
+    replica's ladder) instead of process-global.  ``HEAD``
     answers every route with the headers its ``GET`` would carry.
 """
 
@@ -129,13 +132,18 @@ class MetricsServer:
     """Daemon-threaded stdlib HTTP server over a registry + tracer."""
 
     def __init__(self, registry=None, tracer=None, host: str = "127.0.0.1",
-                 port: int = 0):
+                 port: int = 0, health_fn=None):
+        # ``port=0`` binds an ephemeral port (read back via ``.port``)
+        # so N replicas on one host never collide; ``health_fn`` scopes
+        # /healthz to ONE serving instance (a fleet replica's ladder)
+        # instead of the process-global recovery view.
         if registry is None or tracer is None:
             from . import get_registry, get_tracer
             registry = registry or get_registry()
             tracer = tracer or get_tracer()
         self.registry = registry
         self.tracer = tracer
+        self.health_fn = health_fn
         outer = self
 
         class _Handler(BaseHTTPRequestHandler):
@@ -146,9 +154,12 @@ class MetricsServer:
                 headers a GET would carry."""
                 path = self.path
                 if path.startswith("/healthz"):
-                    from ..recovery.manager import health_status
+                    if outer.health_fn is not None:
+                        health = outer.health_fn()
+                    else:
+                        from ..recovery.manager import health_status
 
-                    health = health_status()
+                        health = health_status()
                     # load balancers read the status code; humans read
                     # the body.  503 while booting/replaying/warming.
                     status = 200 if health.get("ready") else 503
@@ -201,6 +212,11 @@ class MetricsServer:
                     # the merged Chrome trace itself: save the body,
                     # load it in Perfetto (docs/OBSERVABILITY.md)
                     return (json.dumps(timeline.chrome_trace()),
+                            "application/json")
+                if path.startswith("/debug/fleet"):
+                    from ..fleet.router import fleet_status
+
+                    return (json.dumps(fleet_status(), indent=2),
                             "application/json")
                 if path.startswith("/debug/programs"):
                     from . import profile
