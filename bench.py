@@ -1591,6 +1591,10 @@ def bench_fleet_chaos():
         "rejoin_pcache_hits": rj.get("pcache_hits"),
         "rejoin_new_cache_files": rj.get("new_cache_files"),
         "staleness_lsn_final": rj.get("staleness_lsn_final"),
+        "trace_processes": rep.get("observability", {})
+                              .get("trace_processes"),
+        "redispatched_trace_id": rep.get("observability", {})
+                                    .get("redispatched_trace_id"),
         "failures": check(rep),
     }
     if jax.default_backend() != "tpu":
@@ -1710,6 +1714,41 @@ def run_trace_scenario(path):
     return 0 if ok else 1
 
 
+def run_fleet_trace_scenario(path):
+    """``bench.py --fleet-trace``: the replica-failover chaos run with
+    the fleet observability plane live — every process records its
+    timeline, the router federates, and the merged cross-process
+    Perfetto trace (one track per replica plus the router, wall-clock
+    timebase) lands at ``path``.
+
+    Self-checking: returns nonzero unless the merged trace carries
+    events from at least two processes and one redispatched trace_id
+    shows BOTH dispatch attempts on two different replica tracks — the
+    cross-process correlation the federation exists for.
+    """
+    from benchmarks.fleet_chaos import run_fleet_chaos
+
+    rep = run_fleet_chaos(smoke=True, seed=0, trace_path=path)
+    obs = rep.get("observability", {})
+    ok = (obs.get("trace_events", 0) > 0
+          and len(obs.get("trace_processes", ())) >= 2
+          and len(obs.get("redispatch_attempts", ())) >= 2
+          and len(obs.get("trace_replica_tracks", ())) >= 2
+          and bool(obs.get("reconstruction_found")))
+    log(f"fleet-trace: {obs.get('trace_events')} events across "
+        f"{obs.get('trace_processes')}, redispatched trace "
+        f"{obs.get('redispatched_trace_id')} on "
+        f"{obs.get('trace_replica_tracks')}, "
+        f"reconstructed={obs.get('reconstruction_found')}")
+    print(json.dumps(dict(obs, lost_answers=rep.get("lost_answers"),
+                          ok=ok)))
+    if not ok:
+        log("fleet-trace: FAILED acceptance (need a merged trace with "
+            ">=2 processes and one redispatched trace_id on two "
+            "replica tracks)")
+    return 0 if ok else 1
+
+
 # ---------------------------------------------------------------- main
 def main():
     ap = argparse.ArgumentParser()
@@ -1735,6 +1774,12 @@ def main():
                     help="run the compact cross-subsystem timeline "
                          "scenario and export a Perfetto-loadable "
                          "Chrome trace to PATH, then exit")
+    ap.add_argument("--fleet-trace", nargs="?", const="fleet_trace.json",
+                    default=None, metavar="PATH",
+                    help="run the replica-failover chaos scenario with "
+                         "the fleet observability plane live and "
+                         "export the MERGED cross-process Perfetto "
+                         "trace to PATH, then exit")
     ap.add_argument("--check", action="store_true",
                     help="run the noise-aware perf gate "
                          "(benchmarks/perfgate.py) and exit with its "
@@ -1766,6 +1811,9 @@ def main():
 
     if args.trace is not None:
         sys.exit(run_trace_scenario(args.trace))
+
+    if args.fleet_trace is not None:
+        sys.exit(run_fleet_trace_scenario(args.fleet_trace))
 
     want = set(args.sections.split(","))
 

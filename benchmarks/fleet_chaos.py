@@ -25,7 +25,16 @@ on the returned report, and by ``--check`` from the command line):
     compilation cache (``pcache_hits > 0``), write zero new cache
     entries, survive post-warmup traffic under a sealed registry, and
     its staleness watermark must return to 0 (≤ the configured bound)
-    once serving.
+    once serving;
+  * **fleet-wide observability** (docs/OBSERVABILITY.md) — the router
+    runs with federation on and every process records its timeline; a
+    seeded ``fleet.serve`` fault on one follower forces redispatches
+    whose trace_id lands on TWO replica timelines.  After the run the
+    harness exports the merged Perfetto trace via
+    ``timeline.export_fleet`` and asserts it is loadable, contains
+    events from ≥ 2 processes, and shows both dispatch attempts of one
+    redispatched trace_id on two different replica tracks — and that
+    ``/debug/fleet/trace``-style reconstruction finds the story.
 
 The model stage is deliberately tiny (default replica service: a
 versioned graph touch) so the harness runs on CPU in minutes; the
@@ -71,7 +80,8 @@ import glob, json, os, sys, time
 import numpy as np
 import quiver_tpu.config as config_mod
 
-root, fleet_dir, cache_dir, rid, role, ingest_rps = sys.argv[1:7]
+(root, fleet_dir, cache_dir, rid, role, ingest_rps, serve_every,
+ chaos_seed) = sys.argv[1:9]
 # budget 4, not 0: the stream sampler legitimately builds one program
 # per delta-overlay BUCKET it serves (geometric growth schedule), and
 # live ingest crosses a few buckets after warmup.  The seal still
@@ -82,11 +92,24 @@ config_mod.update(recovery_dir=root, recovery_cache_dir=cache_dir,
 from quiver_tpu import GraphSageSampler
 from quiver_tpu.fleet import FleetReplica
 from quiver_tpu.recovery.registry import get_program_registry
+from quiver_tpu.resilience import chaos
 from quiver_tpu.stream import StreamingGraph
+from quiver_tpu.telemetry import flightrec, timeline
 from quiver_tpu.utils.rng import make_key
 from quiver_tpu.utils.topology import CSRTopo
 
 N = 64
+
+# every process records its own timeline; the parent's federation
+# pulls /debug/timeline from each and merges them onto one wall clock
+timeline.enable()
+if int(serve_every) > 0:
+    # deterministic serve faults on THIS follower: accepted requests
+    # answer `unavailable` after trace rehydration, so the router
+    # redispatches and the same trace_id lands on a second replica's
+    # timeline — the cross-process story the merged trace must show
+    chaos.install(chaos.ChaosPlan(seed=int(chaos_seed)).fail(
+        "fleet.serve", times=None, after=1, every=int(serve_every)))
 
 def factory():
     src = np.arange(N, dtype=np.int64)
@@ -101,12 +124,23 @@ def warmup(graph):
                          dedup="none")
     s.sample(np.arange(8), key=make_key(0))
     holder["sampler"] = s
+    holder["graph"] = graph
+
+def service(ids, tenant):
+    # drive the WARMED sampler (fixed shape: no recompile under seal)
+    # and stamp the stage span into the active fleet trace
+    t0 = time.perf_counter()
+    holder["sampler"].sample(np.arange(8), key=make_key(0))
+    flightrec.event("sample", {"seconds": time.perf_counter() - t0})
+    g = holder.get("graph")
+    return {"n": len(ids),
+            "version": int(g.version) if g is not None else -1}
 
 before = set(glob.glob(os.path.join(cache_dir, "**"), recursive=True))
 t0 = time.perf_counter()
 rep = FleetReplica(rid, fleet_dir=fleet_dir, root=root,
                    graph_factory=factory, role=role,
-                   warmup=warmup, seal=True).boot()
+                   warmup=warmup, seal=True, service_fn=service).boot()
 rep.expose_metrics()
 if role == "leader":
     # seed + checkpoint so followers have a restore point
@@ -145,7 +179,8 @@ else:
 """
 
 
-def _spawn(root, fleet_dir, cache_dir, rid, role, ingest_rps=100.0):
+def _spawn(root, fleet_dir, cache_dir, rid, role, ingest_rps=100.0,
+           serve_fault_every=0, chaos_seed=0):
     env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO,
                PYTHONUNBUFFERED="1",
                QUIVER_TPU_FLEET_SHIP_POLL_MS="10",
@@ -153,7 +188,8 @@ def _spawn(root, fleet_dir, cache_dir, rid, role, ingest_rps=100.0):
                QUIVER_TPU_FLEET_HEARTBEAT_S="0.2")
     return subprocess.Popen(
         [sys.executable, "-c", _REPLICA_CHILD, root, fleet_dir,
-         cache_dir, rid, role, str(ingest_rps)],
+         cache_dir, rid, role, str(ingest_rps),
+         str(int(serve_fault_every)), str(int(chaos_seed))],
         cwd=REPO, env=env, stdout=subprocess.PIPE,
         stderr=subprocess.PIPE, text=True)
 
@@ -203,14 +239,73 @@ def _reap(proc):
         proc.wait(timeout=10)
 
 
+def _observability(router, fed, trace_file: str) -> dict:
+    """Export the merged fleet trace and distil the evidence
+    :func:`check` asserts on: trace loadable, events from ≥ 2
+    processes, one redispatched trace_id with both attempts recorded
+    and visible on two replica tracks, reconstruction joins."""
+    from quiver_tpu.telemetry import timeline
+
+    timeline.export_fleet(trace_file)
+    with open(trace_file) as f:
+        doc = json.load(f)
+    track: dict = {}
+    events = []
+    for e in doc.get("traceEvents", ()):
+        if e.get("ph") == "M":
+            if e.get("name") == "process_name":
+                track[e["pid"]] = e["args"]["name"]
+        else:
+            events.append(e)
+    obs: dict = {
+        "trace_path": trace_file,
+        "trace_events": len(events),
+        "trace_processes": sorted({track.get(e["pid"], str(e["pid"]))
+                                   for e in events}),
+    }
+    by_tid: dict = {}
+    for e in events:
+        tid = (e.get("args") or {}).get("trace_id")
+        if tid:
+            by_tid.setdefault(tid, set()).add(
+                track.get(e["pid"], str(e["pid"])))
+    redis = [h for h in router.hop_records(limit=router.hop_capacity)
+             if len(h.get("attempts", ())) >= 2]
+    obs["redispatched_hops"] = len(redis)
+    chosen = None
+    for h in reversed(redis):  # newest first: its events are retained
+        tracks = by_tid.get(h["trace_id"], set())
+        if sum(1 for t in tracks if t.startswith("replica")) >= 2:
+            chosen = h
+            break
+    if chosen is None and redis:
+        chosen = redis[-1]
+    if chosen is not None:
+        tid = chosen["trace_id"]
+        tracks = sorted(by_tid.get(tid, ()))
+        obs["redispatched_trace_id"] = tid
+        obs["redispatch_attempts"] = [
+            {"replica": a["replica"], "outcome": a["outcome"]}
+            for a in chosen["attempts"]]
+        obs["trace_tracks"] = tracks
+        obs["trace_replica_tracks"] = [
+            t for t in tracks if t.startswith("replica")]
+        recon = fed.reconstruct(tid)
+        obs["reconstruction_found"] = bool(recon.get("found"))
+        obs["reconstructed_replicas"] = sorted(recon.get("replicas", ()))
+    return obs
+
+
 def run_fleet_chaos(smoke: bool = False, seed: int = 0,
-                    workdir: str | None = None) -> dict:
+                    workdir: str | None = None,
+                    trace_path: str | None = None) -> dict:
     """Run the failover scenario; returns the structured report."""
     from quiver_tpu.fleet import FleetRouter, MembershipDirectory
     from quiver_tpu.resilience.errors import NoReplicaAvailable
     from quiver_tpu.resilience.qos import (QoSController, install_qos,
                                            parse_tenant_spec)
     from quiver_tpu import telemetry
+    from quiver_tpu.telemetry import timeline
 
     rng = np.random.default_rng(seed)
     tmp = workdir or tempfile.mkdtemp(prefix="fleet_chaos_")
@@ -230,11 +325,17 @@ def run_fleet_chaos(smoke: bool = False, seed: int = 0,
     report: dict = {"seed": seed, "smoke": smoke,
                     "phases": {}, "failover": {}, "rejoin": {}}
     t_start = time.perf_counter()
+    timeline_was_on = timeline.on()
+    timeline.enable()
     try:
         procs["r0"] = _spawn(root, fleet_dir, cache_dir, "r0", "leader")
         boot0 = _wait_ready(procs["r0"])
+        # r1 carries the seeded serve-fault plan: ~1/4 of its admitted
+        # requests answer `unavailable` after trace rehydration, so the
+        # merged trace shows redispatched ids on two replica tracks
         procs["r1"] = _spawn(root, fleet_dir, cache_dir, "r1",
-                             "follower")
+                             "follower", serve_fault_every=4,
+                             chaos_seed=seed)
         procs["r2"] = _spawn(root, fleet_dir, cache_dir, "r2",
                              "follower")
         boot1 = _wait_ready(procs["r1"])
@@ -243,8 +344,11 @@ def run_fleet_chaos(smoke: bool = False, seed: int = 0,
             _wait_serving(directory, rid)
         report["cold_boots"] = [boot0, boot1, boot2]
 
-        router = FleetRouter(directory, scan_ttl_s=0.05,
-                             request_timeout_s=2.0)
+        # 64 partitions (not the 8-partition default): the 3-member
+        # ring must give EVERY replica ownership of some partitions, so
+        # the faulted follower actually sees traffic to redispatch
+        router = FleetRouter(directory, partitions=64, scan_ttl_s=0.05,
+                             request_timeout_s=2.0, federation=True)
 
         def drive(phase: str, count: int, kill_at: int | None = None):
             lat, counts = [], {"offered": 0, "ok": 0, "shed": 0,
@@ -308,6 +412,16 @@ def run_fleet_chaos(smoke: bool = False, seed: int = 0,
 
         drive("cool", n_req["cool"])
 
+        # federation sweeps: harvest heartbeat clock pairs (≥ 2 ticks
+        # apart so the offset estimator sees distinct pairs), scrape
+        # every member, then export + dissect the merged fleet trace
+        for _ in range(3):
+            router.federation.scrape_once()
+            time.sleep(0.3)
+        report["observability"] = _observability(
+            router, router.federation,
+            trace_path or os.path.join(tmp, "fleet_trace.json"))
+
         base_p99 = report["phases"]["baseline"]["p99_ms"] or 1e-9
         report["failover"]["p99_ratio_burst_vs_baseline"] = round(
             report["phases"]["burst"]["p99_ms"] / base_p99, 3)
@@ -326,6 +440,8 @@ def run_fleet_chaos(smoke: bool = False, seed: int = 0,
             time.perf_counter() - t_start, 1)
         router.close()
     finally:
+        if not timeline_was_on:
+            timeline.disable()
         for proc in procs.values():
             _reap(proc)
         for proc in procs.values():
@@ -364,6 +480,23 @@ def check(report: dict) -> list:
     ratio = report["failover"].get("p99_ratio_burst_vs_baseline", 99.0)
     if ratio >= 2.0:
         fails.append(f"failover p99 ratio {ratio} >= 2.0")
+    # the merged failover trace: produced, loadable, cross-process, and
+    # carrying one redispatched trace_id end to end
+    obs = report.get("observability", {})
+    if obs.get("trace_events", 0) <= 0:
+        fails.append("merged fleet trace missing or empty")
+    if len(obs.get("trace_processes", ())) < 2:
+        fails.append("merged trace lacks events from >= 2 processes "
+                     f"({obs.get('trace_processes')})")
+    if len(obs.get("redispatch_attempts", ())) < 2:
+        fails.append("no redispatched request with both dispatch "
+                     "attempts recorded")
+    if len(obs.get("trace_replica_tracks", ())) < 2:
+        fails.append("redispatched trace_id not on two replica tracks "
+                     f"({obs.get('trace_replica_tracks')})")
+    if not obs.get("reconstruction_found", False):
+        fails.append("cross-process trace reconstruction found no "
+                     "record")
     return fails
 
 
@@ -400,6 +533,12 @@ def main():
               f"staleness={r.get('staleness_lsn_final')} "
               f"(bound {r.get('staleness_bound')}) "
               f"backend={report['backend']}")
+        o = report.get("observability", {})
+        print(f"trace     events={o.get('trace_events')} "
+              f"processes={o.get('trace_processes')} "
+              f"redispatched={o.get('redispatched_trace_id')} "
+              f"on_tracks={o.get('trace_replica_tracks')} "
+              f"reconstructed={o.get('reconstruction_found')}")
         print(f"lost_answers={report['lost_answers']} "
               f"elapsed={report['elapsed_seconds']}s")
     # loss/rejoin criteria are backend-independent; the p99 ratio is

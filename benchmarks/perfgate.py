@@ -138,12 +138,83 @@ def _m_sampler_cpu() -> float:
     return (time.perf_counter() - t0) / 5 * 1e3
 
 
+def _m_fleet_trace_stamp() -> float:
+    """ms per 1000 fleet trace stamp + finish pairs — the federation-ON
+    request-path bookkeeping (TraceContext, payload stamp, hop record,
+    timeline slice when on) without any network in the number."""
+    from quiver_tpu.fleet import FleetRouter, MembershipDirectory
+    from quiver_tpu.telemetry import flightrec
+
+    with tempfile.TemporaryDirectory() as fdir:
+        router = FleetRouter(MembershipDirectory(fdir),
+                             federation=True, scan_ttl_s=60.0)
+        rec = flightrec.get_recorder()
+        try:
+            t0 = time.perf_counter()
+            for _ in range(1000):
+                req = {"ids": [1], "tenant": None}
+                ctx, hop = router._trace_begin(req, None, 1)
+                if ctx is None:
+                    raise RuntimeError("telemetry disabled")
+                router._trace_finish(hop, ctx)
+                rec.finish(ctx, 0.0, lane="perfgate")
+            dt = time.perf_counter() - t0
+        finally:
+            router.close()
+    return dt * 1e3
+
+
+def _m_fleet_router_off() -> float:
+    """ms per 200 federation-OFF ``router.request`` round trips against
+    an in-process echo replica — the one-config-check request path the
+    disabled plane must keep byte-identical to PR 13."""
+    import socketserver
+    import threading
+
+    from quiver_tpu.fleet import FleetRouter, MembershipDirectory
+    from quiver_tpu.fleet.membership import ReplicaInfo
+
+    class _Echo(socketserver.StreamRequestHandler):
+        def handle(self):
+            while True:
+                if not self.rfile.readline():
+                    return
+                self.wfile.write(b'{"status": "ok"}\n')
+
+    class _Srv(socketserver.ThreadingTCPServer):
+        allow_reuse_address = True
+        daemon_threads = True
+
+    with tempfile.TemporaryDirectory() as fdir:
+        srv = _Srv(("127.0.0.1", 0), _Echo)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        try:
+            directory = MembershipDirectory(fdir,
+                                            heartbeat_timeout_s=60.0)
+            directory.announce(ReplicaInfo(
+                "echo", state="serving", port=srv.server_address[1]))
+            router = FleetRouter(directory, scan_ttl_s=60.0,
+                                 federation=False)
+            router.request([1])  # warm: scan, ring, breaker, socket
+            t0 = time.perf_counter()
+            for i in range(200):
+                router.request([1], seq=i)
+            dt = time.perf_counter() - t0
+            router.close()
+        finally:
+            srv.shutdown()
+            srv.server_close()
+    return dt * 1e3
+
+
 METRICS: Dict[str, Callable[[], float]] = {
     "wal_append": _m_wal_append,
     "spans": _m_spans,
     "timeline_emit": _m_timeline_emit,
     "prom_text": _m_prom_text,
     "sampler_cpu": _m_sampler_cpu,
+    "fleet_trace_stamp": _m_fleet_trace_stamp,
+    "fleet_router_off": _m_fleet_router_off,
 }
 
 

@@ -299,6 +299,24 @@ class Config:
     fleet_max_staleness_lsn: int = field(
         default_factory=lambda: _env("FLEET_MAX_STALENESS_LSN", 1024, int)
     )
+    # fleet observability plane (quiver_tpu/fleet/federation.py,
+    # docs/OBSERVABILITY.md): master switch for cross-process trace
+    # propagation + metrics federation (off by default — the request
+    # path pays exactly one config check when off), scraper cadence,
+    # router hop-record ring capacity, and the eligible-replica floor
+    # the fleet SLO watchdog alarms on
+    fleet_federation: str = field(
+        default_factory=lambda: _env("FLEET_FEDERATION", "off", str)
+    )
+    fleet_scrape_interval_s: float = field(
+        default_factory=lambda: _env("FLEET_SCRAPE_INTERVAL_S", 0.5, float)
+    )
+    fleet_trace_ring: int = field(
+        default_factory=lambda: _env("FLEET_TRACE_RING", 512, int)
+    )
+    fleet_min_eligible: int = field(
+        default_factory=lambda: _env("FLEET_MIN_ELIGIBLE", 1, int)
+    )
 
 
 _config: Optional[Config] = None

@@ -13,9 +13,16 @@ losing an in-flight request (``benchmarks/fleet_chaos.py``).
   * :mod:`~quiver_tpu.fleet.replica` — replica lifecycle: warm join,
     heartbeats, TCP serving endpoint, drain/rejoin;
   * :mod:`~quiver_tpu.fleet.router` — consistent-hash routing, health
-    gating, per-replica breakers, bounded re-dispatch.
+    gating, per-replica breakers, bounded re-dispatch;
+  * :mod:`~quiver_tpu.fleet.federation` — the fleet observability
+    plane: metrics federation, fleet SLOs, clock-aligned merged
+    timelines, cross-process trace reconstruction
+    (docs/OBSERVABILITY.md).
 """
 
+from .federation import (FleetFederation, FleetSLOWatchdog,
+                         estimate_offsets, federate, federation_status,
+                         get_federation, parse_prometheus_text)
 from .membership import FLEET_STATES, MembershipDirectory, ReplicaInfo
 from .replica import FleetReplica
 from .router import ConsistentHashRing, FleetRouter, fleet_status
@@ -24,4 +31,6 @@ from .shipping import WALFollower
 __all__ = [
     "FLEET_STATES", "MembershipDirectory", "ReplicaInfo", "FleetReplica",
     "ConsistentHashRing", "FleetRouter", "fleet_status", "WALFollower",
+    "FleetFederation", "FleetSLOWatchdog", "estimate_offsets", "federate",
+    "federation_status", "get_federation", "parse_prometheus_text",
 ]

@@ -51,7 +51,9 @@ from .. import telemetry
 from ..recovery.checkpoint import load_checkpoint, restore_graph
 from ..recovery.errors import RecoveryError
 from ..resilience import chaos
-from ..resilience.errors import DeadlineExceeded, LoadShed, QuotaExceeded
+from ..resilience.errors import (ChaosFault, DeadlineExceeded, LoadShed,
+                                 QuotaExceeded)
+from ..telemetry import flightrec
 from .membership import FLEET_STATES, MembershipDirectory, ReplicaInfo
 from .shipping import WALFollower
 
@@ -60,6 +62,11 @@ __all__ = ["FleetReplica"]
 log = logging.getLogger("quiver_tpu.fleet")
 
 _CHAOS_JOIN = chaos.point("fleet.join")
+# fires inside the serving handler after trace rehydration: an injected
+# fault models a replica that accepted the connection but cannot answer
+# (honest `unavailable`, the router re-dispatches) — how the fleet-chaos
+# harness proves one trace_id lands on two replica timelines
+_CHAOS_SERVE = chaos.point("fleet.serve")
 
 # typed sheds cross the wire as answers; everything else is an error
 _SHED_TYPES = (LoadShed, DeadlineExceeded, QuotaExceeded)
@@ -322,22 +329,39 @@ class FleetReplica:
         return self._server.server_address[1] if self._server else 0
 
     def _serve_line(self, line: bytes) -> dict:
+        t_recv = time.perf_counter()
         try:
             req = json.loads(line)
         except ValueError:
+            telemetry.counter("fleet_replica_requests_total",
+                              status="unparsable").inc()
             return {"status": "error", "error": "BadRequest",
                     "reason": "unparsable request line"}
+        tctx = self._rehydrate(req.get("trace"))
         with self._lock:
             admitted = self._state == "serving" and not self._draining
             if admitted:
                 self._inflight += 1
         if not admitted:
+            self._finish_trace(tctx, time.perf_counter() - t_recv,
+                               "unavailable")
             return {"status": "unavailable", "state": self.state,
                     "replica": self.replica_id}
         t0 = time.perf_counter()
         try:
-            out = self._service(req.get("ids", ()), req.get("tenant"))
-            out.setdefault("status", "ok")
+            with flightrec.activate(tctx):
+                if tctx is not None:
+                    # the admission gap is the replica-side queue span
+                    flightrec.event("replica.queue",
+                                    {"seconds": t0 - t_recv})
+                _CHAOS_SERVE()
+                out = self._deadline_service(req, t0)
+                out.setdefault("status", "ok")
+        except ChaosFault as e:
+            # injected serve fault: accepted the connection, cannot
+            # answer — honest refusal, the router re-dispatches
+            out = {"status": "unavailable", "state": self.state,
+                   "error": type(e).__name__}
         except _SHED_TYPES as e:
             # a typed shed is an ANSWER — the router must not retry it
             out = {"status": "shed", "error": type(e).__name__,
@@ -348,11 +372,65 @@ class FleetReplica:
         finally:
             with self._lock:
                 self._inflight -= 1
+        e2e = time.perf_counter() - t0
+        status = out.get("status", "ok")
+        if status in ("ok", "shed", "error"):
+            telemetry.counter("fleet_replica_requests_total",
+                              status=status).inc()
+            telemetry.histogram(
+                "fleet_replica_request_seconds").observe(e2e)
+        self._finish_trace(tctx, e2e, status)
         out["replica"] = self.replica_id
-        out["latency_ms"] = round((time.perf_counter() - t0) * 1e3, 3)
+        out["latency_ms"] = round(e2e * 1e3, 3)
+        if tctx is not None:
+            out["trace_id"] = tctx.trace_id
         if "seq" in req:
             out["seq"] = req["seq"]
         return out
+
+    def _deadline_service(self, req: dict, t0: float) -> dict:
+        """Run the service under the request's shipped deadline budget
+        (re-anchored on THIS process's perf_counter — absolute
+        deadlines do not survive the hop, remaining seconds do)."""
+        trace = req.get("trace")
+        deadline_s = (trace.get("deadline_s")
+                      if isinstance(trace, dict) else None)
+        if deadline_s is None:
+            return self._service(req.get("ids", ()), req.get("tenant"))
+        from ..resilience.deadline import check_ambient, deadline_scope
+
+        with deadline_scope(t0 + float(deadline_s), t0):
+            check_ambient("fleet")  # dead on arrival → typed shed
+            return self._service(req.get("ids", ()), req.get("tenant"))
+
+    def _rehydrate(self, trace):
+        """Adopt the router-stamped TraceContext, so replica-side stage
+        events join the fleet-wide trace_id.  The id arrives already
+        origin-qualified (``<origin>:<local>``), which keeps it
+        disjoint from this process's own ``<pid>-<seq>`` ids.  Costs
+        nothing when no trace rides the payload."""
+        if not isinstance(trace, dict):
+            return None
+        tid = trace.get("trace_id")
+        if not tid:
+            return None
+        tctx = flightrec.new_trace(trace_id=str(tid))
+        if tctx is None:  # telemetry disabled in this process
+            return None
+        tenant = trace.get("tenant")
+        if tenant is not None:
+            tctx.tenant = str(tenant)
+        # fleet-dispatched requests are always retained (the recorder
+        # ring is bounded): /debug/fleet/trace/<id> must find the
+        # replica-side record, not just the slow/errored tail
+        tctx.flag()
+        return tctx
+
+    def _finish_trace(self, tctx, e2e: float, status: str) -> None:
+        if tctx is None:
+            return
+        flightrec.get_recorder().finish(tctx, e2e, status=status,
+                                        lane="fleet")
 
     def _service(self, ids, tenant) -> dict:
         if self.service_fn is not None:
@@ -387,7 +465,13 @@ class FleetReplica:
             wal_next_lsn=int(health.get("wal_next_lsn", -1)),
             detail={"metrics_port":
                     self.metrics_server.port if self.metrics_server
-                    else 0},
+                    else 0,
+                    # perf_counter↔wall pair stamped back-to-back at
+                    # announce time: the federation's clock-offset
+                    # estimator aligns per-replica timelines from the
+                    # heartbeat stream of these (federation.py)
+                    "clock_perf": time.perf_counter(),
+                    "clock_wall": time.time()},
         )
 
     def _announce(self) -> None:

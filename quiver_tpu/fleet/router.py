@@ -39,6 +39,7 @@ import bisect
 import hashlib
 import json
 import logging
+import os
 import socket
 import threading
 import time
@@ -50,6 +51,8 @@ from ..resilience import chaos
 from ..resilience.breaker import get_breaker
 from ..resilience.errors import NoReplicaAvailable
 from ..resilience.retry import Backoff
+from ..telemetry import flightrec
+from ..telemetry import timeline as _timeline
 from .membership import MembershipDirectory, ReplicaInfo
 
 __all__ = ["ConsistentHashRing", "FleetRouter", "fleet_status"]
@@ -122,7 +125,7 @@ class FleetRouter:
 
     _guarded_by = {
         "_eligible": "_lock", "_health_ok": "_lock", "_inflight": "_lock",
-        "_last_scan": "_lock",
+        "_last_scan": "_lock", "_hops": "_lock", "_hop_ids": "_lock",
     }
 
     def __init__(self, directory: MembershipDirectory,
@@ -133,7 +136,9 @@ class FleetRouter:
                  hot_priority: Optional[int] = None,
                  health_poll_s: float = 0.25,
                  scan_ttl_s: float = 0.1,
-                 backoff: Optional[Backoff] = None):
+                 backoff: Optional[Backoff] = None,
+                 federation: Optional[bool] = None,
+                 origin: Optional[str] = None):
         from ..config import get_config
 
         cfg = get_config()
@@ -159,6 +164,23 @@ class FleetRouter:
         self._last_scan = 0.0
         self._hp_stop = threading.Event()
         self._hp_thread: Optional[threading.Thread] = None
+        # fleet observability plane (docs/OBSERVABILITY.md): the flag is
+        # resolved ONCE here, so the off path costs exactly one
+        # attribute read per request — no config lookup, no trace, no
+        # payload stamp, no new metric keys
+        self.federation_enabled = (
+            bool(federation) if federation is not None
+            else str(cfg.fleet_federation).lower()
+            in ("on", "1", "true", "yes"))
+        self.origin = str(origin) if origin else f"rtr-{os.getpid():x}"
+        self.hop_capacity = max(int(cfg.fleet_trace_ring), 1)
+        self._hops: Dict[str, dict] = {}
+        self._hop_ids: List[str] = []
+        self.federation = None
+        if self.federation_enabled:
+            from .federation import FleetFederation
+
+            self.federation = FleetFederation(directory, router=self)
         _set_active(self)
 
     # -- fleet view ----------------------------------------------------
@@ -282,32 +304,55 @@ class FleetRouter:
         req = {"ids": list(map(int, ids)), "tenant": tenant}
         if seq is not None:
             req["seq"] = seq
+        ctx = hop = None
+        if self.federation_enabled:
+            ctx, hop = self._trace_begin(req, tenant, partition)
         attempts = 0
-        for attempt in range(budget):
-            if attempt >= 1:
-                # the fleet may have changed under us (that is the
-                # point of re-dispatch) — rebuild the candidate list
-                self.refresh(force=True)
-                prefs = self.candidates(partition, tenant)
-            target = self._pick(prefs)
-            if target is None:
-                break
-            attempts += 1
-            reply = self._dispatch(target, req)
-            if reply is not None:
-                telemetry.counter("fleet_router_requests_total",
-                                  replica=target,
-                                  status=reply.get("status", "ok")).inc()
-                return reply
-            # transport-level failure: the request is still ours to
-            # answer — re-dispatch after a short breather
-            telemetry.counter("fleet_router_redispatch_total",
-                              replica=target).inc()
-            prefs = [p for p in prefs if p != target]
-            if attempt + 1 < budget:
-                sleep(self.backoff.delay(attempt))
-        telemetry.counter("fleet_router_unroutable_total").inc()
-        raise NoReplicaAvailable(partition, attempts)
+        tried: set = set()
+        try:
+            for attempt in range(budget):
+                if attempt >= 1:
+                    # the fleet may have changed under us (that is the
+                    # point of re-dispatch) — rebuild the candidate
+                    # list, but never hand the request back to a
+                    # replica that already refused it: "unavailable"
+                    # keeps the replica eligible (honest refusal, not a
+                    # health strike), so without the exclusion the
+                    # recomputed preference order re-picks the same
+                    # replica instead of the NEXT one
+                    self.refresh(force=True)
+                    prefs = [p for p
+                             in self.candidates(partition, tenant)
+                             if p not in tried]
+                target = self._pick(prefs)
+                if target is None:
+                    break
+                attempts += 1
+                tried.add(target)
+                t_attempt = time.perf_counter()
+                reply = self._dispatch(target, req)
+                if hop is not None:
+                    self._hop_attempt(hop, ctx, target, t_attempt, reply)
+                if reply is not None:
+                    telemetry.counter("fleet_router_requests_total",
+                                      replica=target,
+                                      status=reply.get("status",
+                                                       "ok")).inc()
+                    if hop is not None:
+                        hop["status"] = reply.get("status", "ok")
+                    return reply
+                # transport-level failure: the request is still ours to
+                # answer — re-dispatch after a short breather
+                telemetry.counter("fleet_router_redispatch_total",
+                                  replica=target).inc()
+                prefs = [p for p in prefs if p != target]
+                if attempt + 1 < budget:
+                    sleep(self.backoff.delay(attempt))
+            telemetry.counter("fleet_router_unroutable_total").inc()
+            raise NoReplicaAvailable(partition, attempts)
+        finally:
+            if hop is not None:
+                self._trace_finish(hop, ctx)
 
     def _pick(self, prefs: List[str]) -> Optional[str]:
         for rid in prefs:
@@ -352,6 +397,113 @@ class FleetRouter:
             with self._lock:
                 self._inflight[replica_id] -= 1
 
+    # -- cross-process tracing (only reached with federation on) -------
+    def _priority(self, tenant: Optional[str]) -> int:
+        if tenant is None:
+            return 0
+        from ..resilience.qos import get_qos
+
+        controller = get_qos()
+        if controller is None:
+            return 0
+        klass = controller.resolve(tenant)
+        return int(klass.priority) if klass is not None else 0
+
+    def _trace_begin(self, req: dict, tenant: Optional[str],
+                     partition: int):
+        """Stamp the active TraceContext into the wire payload and open
+        a hop record.  The trace_id is fleet-qualified in place
+        (``<origin>:<local>``) so the router-side record, the replica's
+        rehydrated record, and every timeline event join on ONE id and
+        per-process ``_next_trace_id`` sequences never collide."""
+        ctx = flightrec.current()
+        if ctx is None:
+            ctx = flightrec.new_trace()
+        if ctx is None:  # telemetry disabled: nothing to propagate
+            return None, None
+        if ":" not in ctx.trace_id:
+            ctx.trace_id = f"{self.origin}:{ctx.trace_id}"
+        trace = {"trace_id": ctx.trace_id, "origin": self.origin,
+                 "tenant": tenant, "priority": self._priority(tenant)}
+        deadline = self._deadline_remaining()
+        if deadline is not None:
+            # ship the REMAINING budget, not the absolute deadline —
+            # perf_counter epochs are per-process; the replica
+            # re-anchors it on its own clock
+            trace["deadline_s"] = deadline
+        req["trace"] = trace
+        hop = {"trace_id": ctx.trace_id, "origin": self.origin,
+               "partition": partition, "tenant": tenant,
+               "priority": trace["priority"],
+               "wall_start": time.time(),
+               "t_start": time.perf_counter(),
+               "attempts": [], "status": "unroutable"}
+        ctx.add("fleet.route", {"partition": partition,
+                                "router": self.origin})
+        return ctx, hop
+
+    @staticmethod
+    def _deadline_remaining() -> Optional[float]:
+        from ..resilience.deadline import ambient_deadline
+
+        deadline = ambient_deadline()
+        if deadline is None:
+            return None
+        return max(deadline - time.perf_counter(), 0.0)
+
+    def _hop_attempt(self, hop: dict, ctx, target: str,
+                     t_attempt: float, reply: Optional[dict]) -> None:
+        dt = time.perf_counter() - t_attempt
+        outcome = ("redispatch" if reply is None
+                   else reply.get("status", "ok"))
+        hop["attempts"].append({
+            "replica": target, "outcome": outcome,
+            "t_offset_s": round(t_attempt - hop["t_start"], 6),
+            "seconds": round(dt, 6),
+        })
+        ctx.add("fleet.dispatch", {"replica": target, "outcome": outcome,
+                                   "seconds": dt})
+        if _timeline._ON:  # one global read when the timeline is off
+            _timeline.emit("fleet.dispatch", cat="fleet", dur_s=dt,
+                           t0=t_attempt,
+                           attrs={"replica": target, "outcome": outcome},
+                           trace=ctx)
+
+    def _trace_finish(self, hop: dict, ctx) -> None:
+        e2e = time.perf_counter() - hop["t_start"]
+        hop["e2e_seconds"] = round(e2e, 6)
+        if _timeline._ON:  # one global read when the timeline is off
+            _timeline.emit("fleet.route", cat="fleet", dur_s=e2e,
+                           t0=hop["t_start"],
+                           attrs={"partition": hop["partition"],
+                                  "status": hop["status"],
+                                  "attempts": len(hop["attempts"])},
+                           trace=ctx)
+        with self._lock:
+            while len(self._hop_ids) >= self.hop_capacity:
+                self._hops.pop(self._hop_ids.pop(0), None)
+            if hop["trace_id"] not in self._hops:
+                self._hop_ids.append(hop["trace_id"])
+            self._hops[hop["trace_id"]] = hop
+
+    def hop_record(self, trace_id: str) -> Optional[dict]:
+        """The router-side record for one fleet trace_id (what
+        ``/debug/fleet/trace/<id>`` joins with the replica's flight
+        record), or None when it aged out of the ring."""
+        with self._lock:
+            hop = self._hops.get(trace_id)
+            return dict(hop) if hop is not None else None
+
+    def hop_records(self, limit: int = 50) -> List[dict]:
+        """The newest retained hop records, oldest first."""
+        with self._lock:
+            ids = self._hop_ids[-max(int(limit), 0):]
+            return [dict(self._hops[i]) for i in ids if i in self._hops]
+
+    def hop_count(self) -> int:
+        with self._lock:
+            return len(self._hop_ids)
+
     # -- introspection -------------------------------------------------
     def status(self) -> dict:
         """JSON view for ``/debug/fleet``."""
@@ -364,6 +516,9 @@ class FleetRouter:
         return {
             "partitions": self.partitions,
             "route_retries": self.route_retries,
+            "federation": self.federation_enabled,
+            "origin": self.origin,
+            "hop_records": self.hop_count(),
             "eligible": eligible,
             "ring_members": list(self.ring.members),
             "inflight": inflight,
@@ -374,6 +529,15 @@ class FleetRouter:
             "membership": self.directory.status(),
         }
 
+    def start_federation(self) -> "FleetRouter":
+        """Start the federation's background sweep (no-op with
+        federation off; tests may call
+        ``router.federation.scrape_once()`` deterministically
+        instead)."""
+        if self.federation is not None:
+            self.federation.start()
+        return self
+
     def close(self, timeout: float = 5.0) -> None:
         from ..resilience.shutdown import join_and_reap
 
@@ -382,6 +546,9 @@ class FleetRouter:
             join_and_reap([self._hp_thread], timeout,
                           component="fleet.route")
             self._hp_thread = None
+        if self.federation is not None:
+            self.federation.stop(timeout)
+            self.federation = None
         _clear_active(self)
 
 

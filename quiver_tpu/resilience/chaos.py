@@ -205,7 +205,8 @@ def point(name: str) -> InjectionPoint:
 # fleet fault-injection points, registered eagerly so a chaos plan can
 # arm them by name before any fleet module is imported — a seeded run
 # replays byte-identically whether the plan or the fleet loads first
-FLEET_POINTS = ("fleet.route", "fleet.ship", "fleet.join")
+FLEET_POINTS = ("fleet.route", "fleet.ship", "fleet.join",
+                "fleet.serve")
 for _name in FLEET_POINTS:
     point(_name)
 del _name

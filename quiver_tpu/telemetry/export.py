@@ -21,7 +21,12 @@ Three views:
     (the unified cross-subsystem Chrome trace — Perfetto-loadable),
     ``/debug/programs`` (top-K per-program time attribution, see
     ``telemetry.profile``), and ``/debug/fleet`` (router + membership
-    view of the replicated serving fleet, see docs/FLEET.md).
+    view of the replicated serving fleet, see docs/FLEET.md).  With a
+    live fleet federation (docs/OBSERVABILITY.md), three more:
+    ``/metrics/fleet`` (federated exposition), ``/debug/fleet/summary``
+    (scrape health + fleet SLOs + clock offsets), and
+    ``/debug/fleet/trace/<id>`` (cross-process request
+    reconstruction).
     ``/healthz`` reports the recovery
     readiness ladder (200 only when ``serving``; 503 while
     booting/replaying/warming — see docs/RECOVERY.md); with
@@ -165,6 +170,17 @@ class MetricsServer:
                     status = 200 if health.get("ready") else 503
                     return (json.dumps(health, indent=2),
                             "application/json", status)
+                if path.startswith("/metrics/fleet"):
+                    # matched BEFORE the /metrics prefix: the federated
+                    # exposition (aggregates + per-replica series), 404
+                    # when no federation is live in this process
+                    from ..fleet.federation import get_federation
+
+                    fed = get_federation()
+                    if fed is None:
+                        return None
+                    return (fed.prometheus_text(),
+                            "text/plain; version=0.0.4")
                 if path.startswith("/metrics.json"):
                     return (to_json(outer.registry.snapshot(), indent=2),
                             "application/json")
@@ -178,9 +194,13 @@ class MetricsServer:
                     from .flightrec import get_recorder
 
                     rec = get_recorder()
+                    from urllib.parse import unquote
+
                     parts = path.rstrip("/").split("/")
                     if len(parts) >= 4 and parts[3]:
-                        record = rec.get(parts[3])
+                        # fleet trace_ids are origin-qualified and
+                        # arrive percent-encoded from the federation
+                        record = rec.get(unquote(parts[3]))
                         if record is None:
                             return None
                         return json.dumps(record, indent=2), "application/json"
@@ -213,6 +233,26 @@ class MetricsServer:
                     # load it in Perfetto (docs/OBSERVABILITY.md)
                     return (json.dumps(timeline.chrome_trace()),
                             "application/json")
+                if path.startswith("/debug/fleet/summary"):
+                    from ..fleet.federation import federation_status
+
+                    return (json.dumps(federation_status(), indent=2),
+                            "application/json")
+                if path.startswith("/debug/fleet/trace/"):
+                    from urllib.parse import unquote
+
+                    from ..fleet.federation import get_federation
+
+                    fed = get_federation()
+                    trace_id = unquote(
+                        path[len("/debug/fleet/trace/"):].rstrip("/"))
+                    if fed is None or not trace_id:
+                        return None
+                    doc = fed.reconstruct(trace_id)
+                    if not doc.get("found"):
+                        return (json.dumps(doc, indent=2),
+                                "application/json", 404)
+                    return json.dumps(doc, indent=2), "application/json"
                 if path.startswith("/debug/fleet"):
                     from ..fleet.router import fleet_status
 

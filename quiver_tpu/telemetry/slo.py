@@ -123,11 +123,7 @@ class SLOWatchdog:
             self._prev = snap
         window = snapshot_delta(prev, snap) if prev is not None else snap
 
-        results = []
-        results.append(self._eval_p99(window))
-        results.append(self._eval_errors(window))
-        if self.coldcache_hit_floor > 0:
-            results.append(self._eval_coldcache(window))
+        results = self._score(window)
 
         from . import counter
 
@@ -150,6 +146,16 @@ class SLOWatchdog:
                 # a reaction bug must not kill the scoring loop — it is
                 # accounted, and the ladder keeps its own telemetry
                 counter("slo_listener_errors_total").inc()
+        return results
+
+    def _score(self, window: dict) -> List[dict]:
+        """The objective battery for one window.  Subclasses replace
+        this to swap objectives while keeping the tick/breach/listener
+        machinery (the fleet federation scores federated snapshots
+        through the same accounting — see fleet/federation.py)."""
+        results = [self._eval_p99(window), self._eval_errors(window)]
+        if self.coldcache_hit_floor > 0:
+            results.append(self._eval_coldcache(window))
         return results
 
     def _eval_p99(self, window: dict) -> dict:

@@ -452,6 +452,45 @@ class TestMetricNames:
         """)
         assert r.findings == []
 
+    def test_fleet_federation_metric_names_pass(self, tmp_path):
+        # the fleet observability plane's metric families
+        # (docs/OBSERVABILITY.md) must lint clean as written
+        r = run_lint(tmp_path, """
+            from quiver_tpu import telemetry
+
+            def sweep(rid, errors, merge_errors):
+                telemetry.counter("fleet_federation_scrapes_total",
+                                  replica=rid).inc()
+                telemetry.counter("fleet_federation_scrape_errors_total",
+                                  replica=rid).inc()
+                telemetry.counter(
+                    "fleet_federation_parse_errors_total").inc(errors)
+                telemetry.counter(
+                    "fleet_federation_merge_errors_total").inc(merge_errors)
+
+            def serve(status, e2e):
+                telemetry.counter("fleet_replica_requests_total",
+                                  status=status).inc()
+                telemetry.histogram(
+                    "fleet_replica_request_seconds").observe(e2e)
+        """)
+        assert r.findings == []
+
+    def test_fleet_metric_name_drift_flagged(self, tmp_path):
+        # the shapes a federation patch is most likely to regress into:
+        # camelCase and a unitless duration name
+        r = run_lint(tmp_path, """
+            from quiver_tpu import telemetry
+
+            def f(e2e):
+                telemetry.counter("fleetFederationScrapes").inc()
+                telemetry.histogram(
+                    "fleet_replica_request_time").observe(e2e)
+        """)
+        assert codes(r) == ["QT006", "QT006"]
+        assert "snake_case" in r.findings[0].message
+        assert "unit suffix" in r.findings[1].message
+
 
 # ------------------------------------------------------------ QT007
 class TestSilentExcept:
