@@ -1,7 +1,7 @@
 # Top-level convenience targets (parity: reference ./configure && make).
 .PHONY: all native test test-quick test-native asan bench smoke \
 	telemetry-check chaos stream lint sanitize recovery crash qos \
-	paged timeline perfgate fleet fleet-chaos help
+	paged timeline perfgate fleet fleet-chaos mesh help
 
 all: native
 
@@ -93,5 +93,11 @@ fleet-chaos:
 	python -m pytest tests/ -m fleet -q
 	python benchmarks/fleet_chaos.py --smoke
 
+# mesh-native sharded serving suite: 8-virtual-device CPU rehearsal,
+# sharded gather/sampling bit-identity, shard-group failover, coherent
+# group WAL (docs/SHARDING.md)
+mesh:
+	python -m pytest tests/ -m mesh -q
+
 help:
-	@echo "targets: native | test | test-quick | test-native | asan | bench | smoke | telemetry-check | chaos | stream | lint | sanitize | recovery | crash | qos | paged | timeline | perfgate | fleet | fleet-chaos | help"
+	@echo "targets: native | test | test-quick | test-native | asan | bench | smoke | telemetry-check | chaos | stream | lint | sanitize | recovery | crash | qos | paged | timeline | perfgate | fleet | fleet-chaos | mesh | help"

@@ -1607,6 +1607,167 @@ def bench_fleet_chaos():
     return out
 
 
+# ---------------------------------------------------------------- mesh
+def _mesh_serving_measure(n_nodes, dim, batch_rows, iters,
+                          shard_counts):
+    """Core mesh measurement — assumes the CURRENT process already
+    sees enough devices (a TPU slice, or the CPU-rehearsal
+    ``--xla_force_host_platform_device_count`` flag the wrapper sets
+    before jax initializes).
+
+    Same epoch protocol as ``bench_feature_paged``: fixed id streams,
+    a warm epoch that faults pages / restacks the sharded views /
+    pre-builds the gather ladder, then a steady epoch counted under
+    ``retrace_guard.count_jit_builds`` — the acceptance number is
+    steady-state builds == 0 at every shard count.
+    """
+    import jax
+
+    from quiver_tpu import telemetry
+    from quiver_tpu.analysis.retrace_guard import count_jit_builds
+    from quiver_tpu.mesh import MeshFeature, MeshSampler
+    from quiver_tpu.telemetry.registry import metric_key
+
+    rng = np.random.default_rng(23)
+    table = rng.normal(size=(n_nodes, dim)).astype(np.float32)
+    # small CSR for the frontier-exchange leg (avg degree ~8)
+    deg = rng.integers(4, 12, size=n_nodes)
+    indptr = np.zeros(n_nodes + 1, dtype=np.int64)
+    np.cumsum(deg, out=indptr[1:])
+    indices = rng.integers(0, n_nodes, size=int(indptr[-1])).astype(
+        np.int64)
+    B = min(batch_rows, 4096)
+    k = 8
+    elems_m = B * dim / 1e6
+    streams = [rng.integers(0, n_nodes, size=B) for _ in range(iters)]
+    n_dev = len(jax.devices())
+    counts = [s for s in shard_counts if s <= n_dev]
+    skipped = [s for s in shard_counts if s > n_dev]
+
+    def halo(direction):
+        return telemetry.snapshot()["counters"].get(
+            metric_key("mesh_halo_bytes_total",
+                       {"direction": direction}), 0.0)
+
+    was = telemetry.enabled()
+    telemetry.set_enabled(True)
+    out = {"rows": B, "dim": dim, "n_nodes": n_nodes, "iters": iters,
+           "fanout_k": k, "devices": n_dev,
+           "backend": jax.default_backend(), "shards": {}}
+    if skipped:
+        out["skipped_shard_counts"] = skipped
+        log(f"mesh_serving: shard counts {skipped} skipped — only "
+            f"{n_dev} device(s) visible")
+    try:
+        import jax.random as jrandom
+
+        for S in counts:
+            mf = MeshFeature(table, n_shards=S)
+            ms_samp = MeshSampler(indptr, indices, n_shards=S,
+                                  mesh=mf.mesh)
+            key = jrandom.PRNGKey(0)
+            # warm epoch: page faults + restack + executable ladder
+            for ids in streams:
+                ms_samp.sample(ids, k, key)
+                r = mf[ids]
+            r.block_until_ready()
+            mf.warm_executables()
+            execs_warm = (mf.stats()["executables"]
+                          + ms_samp.stats()["executables"])
+            send0, recv0 = halo("send"), halo("recv")
+            restacks0 = mf.stats()["restacks"]
+            t_gather = t_sample = 0.0
+            with count_jit_builds() as counter:
+                t0 = time.perf_counter()
+                for ids in streams:
+                    so = ms_samp.sample(ids, k, key)
+                so.nbrs.block_until_ready()
+                t_sample = time.perf_counter() - t0
+                t0 = time.perf_counter()
+                for ids in streams:
+                    r = mf[ids]
+                r.block_until_ready()
+                t_gather = time.perf_counter() - t0
+            g_ms = t_gather / iters * 1e3
+            out["shards"][str(S)] = dict(
+                ms_per_batch_gather=round(g_ms, 3),
+                ms_per_1m_elems=round(g_ms / elems_m, 3),
+                ms_per_batch_sample=round(t_sample / iters * 1e3, 3),
+                halo_send_bytes=halo("send") - send0,
+                halo_recv_bytes=halo("recv") - recv0,
+                executables_after_warmup=execs_warm,
+                steady_builds=counter.builds,
+                steady_restacks=mf.stats()["restacks"] - restacks0,
+            )
+    finally:
+        telemetry.set_enabled(was)
+    if jax.default_backend() != "tpu":
+        out["source"] = "cpu_rehearsal"
+    return out
+
+
+def bench_mesh_serving(n_nodes, dim, batch_rows, iters=20,
+                       shard_counts=(1, 2, 4, 8)):
+    """Mesh-native sharded serving (quiver_tpu.mesh): the steady-state
+    sample -> gather hot path at shard counts {1,2,4,8} on one logical
+    replica.
+
+    Reported per shard count: steady ms per 1M gathered elements, the
+    halo-exchange bytes the collective moved (``mesh_halo_bytes_total``
+    deltas), executables resident after warmup, and builds observed
+    DURING the steady epoch (must be 0 — the ladder-key discipline is
+    the point, measured by ``retrace_guard``, not estimated).
+
+    Honesty: off-TPU the mesh is the 8-virtual-device CPU rehearsal
+    (``XLA_FLAGS=--xla_force_host_platform_device_count``) running in a
+    child process — the flag must be set before jax initializes, and
+    this parent typically already initialized a 1-device CPU backend.
+    Those numbers are logic-exact, performance-meaningless, stamped
+    ``source="cpu_rehearsal"``; on a real slice the measurement runs
+    in-process against the chips.
+    """
+    import subprocess
+
+    import jax
+
+    cfg = dict(n_nodes=int(n_nodes), dim=int(dim),
+               batch_rows=int(batch_rows), iters=int(iters),
+               shard_counts=list(shard_counts))
+    if jax.default_backend() == "tpu":
+        out = _mesh_serving_measure(**cfg)
+    else:
+        code = ("import json, sys\n"
+                "import bench\n"
+                "cfg = json.loads(sys.argv[1])\n"
+                "print(json.dumps(bench._mesh_serving_measure(**cfg)))\n")
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                            " --xla_force_host_platform_device_count=" +
+                            str(max(shard_counts))).strip()
+        proc = subprocess.run(
+            [sys.executable, "-c", code, json.dumps(cfg)],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            env=env, capture_output=True, text=True, timeout=850)
+        if proc.returncode != 0:
+            log(f"mesh_serving: rehearsal child failed rc="
+                f"{proc.returncode}: {proc.stderr[-2000:]}")
+            return {"error": f"child rc={proc.returncode}",
+                    "source": "cpu_rehearsal"}
+        out = json.loads(proc.stdout.strip().splitlines()[-1])
+    worst = max((s["steady_builds"] for s in out["shards"].values()),
+                default=0)
+    per = ", ".join(
+        f"S={S}: {s['ms_per_1m_elems']} ms/1M elems, "
+        f"halo {int(s['halo_send_bytes'])}B, "
+        f"{s['executables_after_warmup']} programs"
+        for S, s in sorted(out["shards"].items(), key=lambda kv: int(kv[0])))
+    log(f"mesh_serving ({'cpu rehearsal' if 'source' in out else 'live'}"
+        f", {out['devices']} devices): {per} "
+        f"(worst steady-state builds: {worst})")
+    return out
+
+
 def run_trace_scenario(path):
     """``bench.py --trace``: one compact run with the unified timeline
     live across serving, the program registry, the paged feature store,
@@ -1761,7 +1922,7 @@ def main():
                             "serving,serving_flightrec,"
                             "serving_resilience,serving_qos,"
                             "stream_ingest,restart_warm,fleet_chaos,"
-                            "quality",
+                            "mesh_serving,quality",
                     help="comma-separated subset to run")
     ap.add_argument("--ab-dedup", action="store_true",
                     help="also measure dedup='hop' for sampling + e2e")
@@ -2011,6 +2172,15 @@ def main():
                        n_records=50 if args.small else 200))
     if "fleet_chaos" in want:
         runner.run("fleet_chaos", 900, bench_fleet_chaos)
+    if "mesh_serving" in want:
+        # mesh-specific sizing: the CPU rehearsal materializes the
+        # sharded frame stacks, so it runs a 200k-row table, not the
+        # products-scale one the single-device feature sections use
+        runner.run("mesh_serving", 900,
+                   lambda: bench_mesh_serving(
+                       n_nodes=50_000 if args.small else 200_000,
+                       dim=feat_dim, batch_rows=batches[0],
+                       iters=max(10, args.iters // 2)))
 
     if "sampling" in want:
         if args.gather_mode or args.small:

@@ -97,6 +97,7 @@ _DEFAULT_HOT = (
     "quiver_tpu/stream/*.py",
     "quiver_tpu/recovery/*.py",
     "quiver_tpu/fleet/*.py",
+    "quiver_tpu/mesh/*.py",
 )
 
 

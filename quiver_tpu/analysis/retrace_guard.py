@@ -182,6 +182,21 @@ def count_jit_builds():
     except ImportError:
         pass
     try:
+        from quiver_tpu.mesh.feature import MeshFeature
+        from quiver_tpu.mesh.sampler import MeshSampler
+        # mesh tier: the sharded-gather collective and page-fault
+        # scatter key into _cache; the frontier-exchange combine into
+        # _jitted — steady-state serving over warmed ladders must hold
+        # all three flat
+        patch(MeshFeature, "_gather_fn",
+              _count_cache_growth(counter, "mesh._gather_fn", "_cache"))
+        patch(MeshFeature, "_fault_fn",
+              _count_cache_growth(counter, "mesh._fault_fn", "_cache"))
+        patch(MeshSampler, "_combine_fn",
+              _count_cache_growth(counter, "mesh._combine_fn", "_jitted"))
+    except ImportError:
+        pass
+    try:
         from quiver_tpu.serving import InferenceServer
         patch(InferenceServer, "_fused_forward",
               _count_cache_growth(counter, "serving._fused_forward",

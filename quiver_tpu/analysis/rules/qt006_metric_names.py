@@ -12,8 +12,10 @@ every ``telemetry.counter/gauge/histogram`` call:
     f-string, concatenation, or variable);
   * the name carries a unit suffix: ``_total`` (counts), ``_seconds``
     (durations), ``_bytes`` (sizes), ``_state`` (enum gauges),
-    ``_level`` (ordinal gauges — the QoS degradation ladder), or
-    ``_lsn`` (log-sequence-number watermarks — WAL shipping lag);
+    ``_level`` (ordinal gauges — the QoS degradation ladder),
+    ``_lsn`` (log-sequence-number watermarks — WAL shipping lag),
+    ``_rows`` (row-count gauges — mesh frontier ownership), or
+    ``_members`` (membership-count gauges — fleet shard groups);
   * label keys are literal keyword arguments — ``**labels`` expansion
     hides the key set from static inspection and is flagged.
 
@@ -35,7 +37,7 @@ from ..core import Finding, ModuleContext, Rule, dotted_call_name
 
 _FACTORIES = {"counter", "gauge", "histogram"}
 _UNIT_SUFFIXES = ("_total", "_seconds", "_bytes", "_state", "_level",
-                  "_lsn")
+                  "_lsn", "_rows", "_members")
 _SNAKE = re.compile(r"^[a-z][a-z0-9_]*$")
 # factory kwargs that are API options, not metric labels
 _OPTION_KWARGS = {"bounds", "help"}

@@ -317,6 +317,25 @@ class Config:
     fleet_min_eligible: int = field(
         default_factory=lambda: _env("FLEET_MIN_ELIGIBLE", 1, int)
     )
+    # mesh-native sharded serving (quiver_tpu/mesh, docs/SHARDING.md):
+    # number of row-range shards one logical replica spans (0 = off; the
+    # whole mesh tier is dark and every code path is byte-identical to
+    # the unsharded build), the shard-group id this process announces to
+    # the fleet directory, this process's shard index within the group,
+    # and the per-shard overlay pool size in pages (0 = size to the
+    # batch working set at build)
+    mesh_shards: int = field(
+        default_factory=lambda: _env("MESH_SHARDS", 0, int)
+    )
+    mesh_group: str = field(
+        default_factory=lambda: _env("MESH_GROUP", "", str)
+    )
+    mesh_shard_index: int = field(
+        default_factory=lambda: _env("MESH_SHARD_INDEX", 0, int)
+    )
+    mesh_pool_pages: int = field(
+        default_factory=lambda: _env("MESH_POOL_PAGES", 0, int)
+    )
 
 
 _config: Optional[Config] = None

@@ -30,7 +30,8 @@ from .. import telemetry
 from ..recovery import blockio
 from ..recovery.manager import RECOVERY_STATES
 
-__all__ = ["FLEET_STATES", "ReplicaInfo", "MembershipDirectory"]
+__all__ = ["FLEET_STATES", "ReplicaInfo", "MembershipDirectory",
+           "shard_groups", "group_complete"]
 
 # the recovery ladder plus the explicit-drain state; order is the gauge
 # encoding of fleet_replica_state
@@ -67,6 +68,31 @@ class ReplicaInfo:
         now = time.time() if now is None else now
         return (now - self.heartbeat) <= timeout_s
 
+    # -- shard-group membership (docs/SHARDING.md) ---------------------
+    # Shard groups ride the extensible ``detail`` dict, so records from
+    # pre-mesh builds parse unchanged and an unsharded fleet never
+    # carries the keys at all.
+    @property
+    def shard_group(self) -> Optional[str]:
+        """Group id when this member is one shard of a logical replica
+        spanning several processes; None for a whole-graph replica."""
+        g = self.detail.get("shard_group")
+        return str(g) if g else None
+
+    @property
+    def shard_index(self) -> int:
+        try:
+            return int(self.detail.get("shard_index", 0))
+        except (TypeError, ValueError):
+            return 0
+
+    @property
+    def shard_count(self) -> int:
+        try:
+            return int(self.detail.get("shard_count", 0))
+        except (TypeError, ValueError):
+            return 0
+
     def to_dict(self) -> dict:
         return {
             "replica_id": self.replica_id, "state": self.state,
@@ -92,6 +118,36 @@ class ReplicaInfo:
             wal_next_lsn=int(d.get("wal_next_lsn", -1)),
             detail=dict(d.get("detail", {})),
         )
+
+
+def shard_groups(infos: List[ReplicaInfo]) -> Dict[str, List[ReplicaInfo]]:
+    """Group shard members by group id, sorted by shard index.  Members
+    without a ``shard_group`` (whole-graph replicas) are not included —
+    they route as singletons."""
+    groups: Dict[str, List[ReplicaInfo]] = {}
+    for info in infos:
+        gid = info.shard_group
+        if gid is not None:
+            groups.setdefault(gid, []).append(info)
+    for members in groups.values():
+        members.sort(key=lambda r: (r.shard_index, r.replica_id))
+    return groups
+
+
+def group_complete(members: List[ReplicaInfo]) -> bool:
+    """A shard group is routable only when EVERY declared shard is
+    present exactly once: each member's declared ``shard_count`` must
+    agree and the shard indices must be exactly ``{0 .. n-1}`` — a
+    half-booted or split-brained group never takes traffic."""
+    if not members:
+        return False
+    counts = {m.shard_count for m in members}
+    if len(counts) != 1:
+        return False
+    n = counts.pop()
+    if n < 1 or len(members) != n:
+        return False
+    return sorted(m.shard_index for m in members) == list(range(n))
 
 
 class MembershipDirectory:

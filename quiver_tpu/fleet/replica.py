@@ -95,7 +95,10 @@ class FleetReplica:
                  service_fn: Optional[Callable] = None,
                  heartbeat_s: Optional[float] = None,
                  warmup: Optional[Callable] = None, seal: bool = False,
-                 catchup_timeout_s: float = 30.0):
+                 catchup_timeout_s: float = 30.0,
+                 shard_group: Optional[str] = None,
+                 shard_index: Optional[int] = None,
+                 shard_count: Optional[int] = None):
         from ..config import get_config
 
         cfg = get_config()
@@ -127,6 +130,16 @@ class FleetReplica:
         self.catchup_timeout_s = float(catchup_timeout_s)
         self.heartbeat_s = float(heartbeat_s if heartbeat_s is not None
                                  else cfg.fleet_heartbeat_s)
+        # mesh shard-group membership (docs/SHARDING.md): defaults come
+        # from the mesh_* config knobs so every member of a sharded
+        # launch announces the same group without per-process plumbing;
+        # unsharded replicas (no group) announce exactly as before
+        self.shard_group = str(shard_group if shard_group is not None
+                               else cfg.mesh_group)
+        self.shard_index = int(shard_index if shard_index is not None
+                               else cfg.mesh_shard_index)
+        self.shard_count = int(shard_count if shard_count is not None
+                               else cfg.mesh_shards)
         self.graph = None
         self.manager = None           # leader only (RecoveryManager)
         self.lane = None              # leader only (IngestLane)
@@ -457,21 +470,26 @@ class FleetReplica:
     # -- membership / heartbeat ---------------------------------------
     def _info(self) -> ReplicaInfo:
         health = self.health()
+        detail = {"metrics_port":
+                  self.metrics_server.port if self.metrics_server
+                  else 0,
+                  # perf_counter↔wall pair stamped back-to-back at
+                  # announce time: the federation's clock-offset
+                  # estimator aligns per-replica timelines from the
+                  # heartbeat stream of these (federation.py)
+                  "clock_perf": time.perf_counter(),
+                  "clock_wall": time.time()}
+        if self.shard_group:
+            detail["shard_group"] = self.shard_group
+            detail["shard_index"] = self.shard_index
+            detail["shard_count"] = self.shard_count
         return ReplicaInfo(
             replica_id=self.replica_id, state=self.state, host=self.host,
             port=self.port, role=self.role, pid=os.getpid(),
             staleness_lsn=int(health.get("staleness_lsn", 0)),
             staleness_seconds=float(health.get("staleness_seconds", 0.0)),
             wal_next_lsn=int(health.get("wal_next_lsn", -1)),
-            detail={"metrics_port":
-                    self.metrics_server.port if self.metrics_server
-                    else 0,
-                    # perf_counter↔wall pair stamped back-to-back at
-                    # announce time: the federation's clock-offset
-                    # estimator aligns per-replica timelines from the
-                    # heartbeat stream of these (federation.py)
-                    "clock_perf": time.perf_counter(),
-                    "clock_wall": time.time()},
+            detail=detail,
         )
 
     def _announce(self) -> None:

@@ -13,6 +13,14 @@ graph state — only three views it can rebuild at any moment:
     (connect timeout, garbage reply) stops receiving them after
     ``failure_threshold`` strikes and is re-probed half-open.
 
+With mesh-native sharded serving (docs/SHARDING.md) the routable unit
+may be a *shard group*: N processes that together hold one logical
+replica.  A complete, fully healthy group enters the ring as
+``group:<gid>`` (breaker key ``fleet.group:<gid>``) and requests land
+on its shard-0 coordinator; any missing or unhealthy member removes the
+WHOLE group from the ring, so callers get a typed
+:class:`NoReplicaAvailable` instead of a partial answer.
+
 Placement is consistent hashing over *partitions*, not raw ids: the
 partition of a request is ``ids[0] % config.fleet_partitions`` (the
 locality-partition shape GNNSampler argues for — requests for the same
@@ -53,7 +61,8 @@ from ..resilience.errors import NoReplicaAvailable
 from ..resilience.retry import Backoff
 from ..telemetry import flightrec
 from ..telemetry import timeline as _timeline
-from .membership import MembershipDirectory, ReplicaInfo
+from .membership import (MembershipDirectory, ReplicaInfo, group_complete,
+                         shard_groups)
 
 __all__ = ["ConsistentHashRing", "FleetRouter", "fleet_status"]
 
@@ -126,6 +135,7 @@ class FleetRouter:
     _guarded_by = {
         "_eligible": "_lock", "_health_ok": "_lock", "_inflight": "_lock",
         "_last_scan": "_lock", "_hops": "_lock", "_hop_ids": "_lock",
+        "_groups": "_lock",
     }
 
     def __init__(self, directory: MembershipDirectory,
@@ -159,6 +169,7 @@ class FleetRouter:
         self.ring = ConsistentHashRing(vnodes)
         self._lock = threading.Lock()
         self._eligible: Dict[str, ReplicaInfo] = {}
+        self._groups: Dict[str, List[ReplicaInfo]] = {}
         self._health_ok: Dict[str, bool] = {}
         self._inflight: Dict[str, int] = {}
         self._last_scan = 0.0
@@ -198,10 +209,25 @@ class FleetRouter:
                  if r.state == "serving"}
         with self._lock:
             health = dict(self._health_ok)
+        # shard groups (docs/SHARDING.md) route as ONE unit: a complete,
+        # fully healthy group enters the ring as "group:<gid>" with its
+        # shard-0 member as the dispatch coordinator; an incomplete or
+        # partially unhealthy group takes NO traffic — one dead shard
+        # makes the whole logical replica unavailable, never a partial
+        # answer.  Whole-graph replicas still route as singletons.
         eligible = {rid: r for rid, r in fresh.items()
-                    if health.get(rid, True)}
+                    if r.shard_group is None and health.get(rid, True)}
+        groups: Dict[str, List[ReplicaInfo]] = {}
+        for gid, members in shard_groups(list(fresh.values())).items():
+            telemetry.gauge("fleet_shard_group_members", group=gid).set(
+                float(len(members)))
+            if group_complete(members) and all(
+                    health.get(m.replica_id, True) for m in members):
+                groups[gid] = members
+                eligible[f"group:{gid}"] = members[0]
         with self._lock:
             self._eligible = eligible
+            self._groups = groups
         self.ring.set_members(eligible.keys())
         telemetry.gauge("fleet_router_eligible_total").set(
             float(len(eligible)))
@@ -213,9 +239,15 @@ class FleetRouter:
         import urllib.request
 
         with self._lock:
-            targets = [(r.replica_id, r.host,
-                        int(r.detail.get("metrics_port", 0)))
-                       for r in self._eligible.values()]
+            infos = list(self._eligible.values())
+            # group units carry only the coordinator in _eligible; poll
+            # EVERY member so a wedged non-coordinator shard still takes
+            # the whole group off the ring on the next refresh
+            for members in self._groups.values():
+                infos.extend(members)
+        targets = sorted({(r.replica_id, r.host,
+                           int(r.detail.get("metrics_port", 0)))
+                          for r in infos})
         for rid, host, mport in targets:
             if mport <= 0:
                 continue  # no health endpoint: membership state governs
@@ -513,8 +545,11 @@ class FleetRouter:
             eligible = sorted(self._eligible)
             inflight = dict(self._inflight)
             health = dict(self._health_ok)
+            groups = {gid: [m.replica_id for m in members]
+                      for gid, members in self._groups.items()}
         return {
             "partitions": self.partitions,
+            "shard_groups": groups,
             "route_retries": self.route_retries,
             "federation": self.federation_enabled,
             "origin": self.origin,

@@ -318,6 +318,21 @@ class PagedStore:
                 jnp.asarray(blk_np), jnp.asarray(row_lp),
                 jnp.asarray(row_off), jnp.asarray(rank), B)
 
+    def frame_of_pages(self) -> np.ndarray:
+        """Logical page -> resident frame map (``-1`` = HOST), the flat
+        view the mesh tier stacks into its sharded page table — hot
+        pages are pinned at their own index, overlay residents read
+        from the CLOCK cache's slot map.  Caller holds ``_plock``."""
+        t = self.table
+        out = np.full(t.n_pages, -1, dtype=np.int32)
+        out[:t.hot_pages] = np.arange(t.hot_pages, dtype=np.int32)
+        if t.cache is not None:
+            slot = t.cache.slot_of
+            resident = slot >= 0
+            out[t.hot_pages:][resident] = (
+                t.hot_pages + slot[resident]).astype(np.int32)
+        return out
+
     def finish(self, staged, feature):
         """Run the (cached) paged gather program over a staged plan."""
         (_, frames, blk_pages, blk_np, row_lp, row_off, rank, B) = staged

@@ -26,18 +26,20 @@ __all__ = [
     "RetraceBudgetExceeded",
     "ProgramCache", "ProgramRegistry", "get_program_registry",
     "program_cache",
-    "blockio", "wal", "checkpoint", "manager",
+    "blockio", "wal", "checkpoint", "manager", "shardwal",
     "WriteAheadLog", "RecoveryManager", "health_status",
+    "ShardGroupWAL",
 ]
 
 _LAZY = {
     "blockio": ".blockio", "wal": ".wal", "checkpoint": ".checkpoint",
-    "manager": ".manager",
+    "manager": ".manager", "shardwal": ".shardwal",
 }
 _LAZY_NAMES = {
     "WriteAheadLog": ("wal", "WriteAheadLog"),
     "RecoveryManager": ("manager", "RecoveryManager"),
     "health_status": ("manager", "health_status"),
+    "ShardGroupWAL": ("shardwal", "ShardGroupWAL"),
 }
 
 

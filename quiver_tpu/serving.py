@@ -600,6 +600,10 @@ class InferenceServer:
         for b in self.BUCKETS:
             ids = np.full(b, example_node, dtype=np.int64)
             self._run_bucketed(ids)
+        if hasattr(self.feature, "warm_executables"):
+            # mesh-sharded feature stores pre-build their collective
+            # gather ladder too — steady-state serving must trace 0
+            self.feature.warm_executables()
         return self
 
     def _infer_device(self, req: ServingRequest):

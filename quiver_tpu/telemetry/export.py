@@ -20,8 +20,10 @@ Three views:
     levels, degradation-ladder level + history), ``/debug/timeline``
     (the unified cross-subsystem Chrome trace — Perfetto-loadable),
     ``/debug/programs`` (top-K per-program time attribution, see
-    ``telemetry.profile``), and ``/debug/fleet`` (router + membership
-    view of the replicated serving fleet, see docs/FLEET.md).  With a
+    ``telemetry.profile``), ``/debug/mesh`` (live mesh feature/sampler
+    shard stats, see docs/SHARDING.md), and ``/debug/fleet`` (router +
+    membership view of the replicated serving fleet, see
+    docs/FLEET.md).  With a
     live fleet federation (docs/OBSERVABILITY.md), three more:
     ``/metrics/fleet`` (federated exposition), ``/debug/fleet/summary``
     (scrape health + fleet SLOs + clock offsets), and
@@ -257,6 +259,11 @@ class MetricsServer:
                     from ..fleet.router import fleet_status
 
                     return (json.dumps(fleet_status(), indent=2),
+                            "application/json")
+                if path.startswith("/debug/mesh"):
+                    from ..mesh import mesh_status
+
+                    return (json.dumps(mesh_status(), indent=2),
                             "application/json")
                 if path.startswith("/debug/programs"):
                     from . import profile

@@ -68,6 +68,37 @@ def rng():
     return np.random.default_rng(0)
 
 
+# ---------------------------------------------------------------------------
+# Multi-device / multi-process helpers for the mesh tier (docs/SHARDING.md).
+# The suite itself already runs on 8 virtual CPU devices (above); tests
+# that need a SEPARATE process with its own device count (shard-group
+# members, device-count isolation) spawn one through this helper.
+def run_devices_subprocess(code, n_devices=8, env=None, timeout=120):
+    """Run ``code`` in a fresh python with ``n_devices`` virtual CPU
+    devices; returns the CompletedProcess (caller asserts on
+    returncode/stdout).  The child re-stages JAX_PLATFORMS/XLA_FLAGS
+    before its first jax import, exactly like this conftest."""
+    import subprocess
+    import sys
+
+    child_env = dict(os.environ)
+    child_env["JAX_PLATFORMS"] = "cpu"
+    child_env["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={int(n_devices)}")
+    if env:
+        child_env.update(env)
+    return subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=timeout, env=child_env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+@pytest.fixture
+def devices_subprocess():
+    """Fixture form of :func:`run_devices_subprocess` for mesh tests."""
+    return run_devices_subprocess
+
+
 def make_random_csr(n_nodes=200, avg_deg=8, seed=0, power_law=False):
     """Random graph fixture (parity: gen_random_graph,
     tests/cpp/test_quiver.cu:17-85)."""

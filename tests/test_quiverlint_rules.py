@@ -476,6 +476,29 @@ class TestMetricNames:
         """)
         assert r.findings == []
 
+    def test_mesh_metric_names_pass(self, tmp_path):
+        # the mesh tier's metric families (docs/SHARDING.md /
+        # docs/OBSERVABILITY.md) must lint clean exactly as written:
+        # _rows and _members are recognized count-unit suffixes
+        r = run_lint(tmp_path, """
+            from quiver_tpu import telemetry
+
+            def gather(seconds, halo, owned, shard):
+                telemetry.histogram(
+                    "mesh_shard_gather_seconds").observe(seconds)
+                telemetry.counter("mesh_halo_bytes_total",
+                                  direction="send").inc(halo)
+                telemetry.counter("mesh_halo_bytes_total",
+                                  direction="recv").inc(halo)
+                telemetry.gauge("mesh_shard_frontier_rows",
+                                shard=shard).set(owned)
+
+            def route(gid, n):
+                telemetry.gauge("fleet_shard_group_members",
+                                group=gid).set(n)
+        """)
+        assert r.findings == []
+
     def test_fleet_metric_name_drift_flagged(self, tmp_path):
         # the shapes a federation patch is most likely to regress into:
         # camelCase and a unitless duration name
