@@ -38,17 +38,24 @@ chaos:
 stream:
 	python -m pytest tests/ -m stream -q
 
-# quiverlint: hot-path + whole-program concurrency static analysis
-# (docs/STATIC_ANALYSIS.md); --strict-baseline also fails on stale
-# baseline entries so the debt ledger can only shrink
+# quiverlint: hot-path + whole-program concurrency + staging-dataflow
+# static analysis (docs/STATIC_ANALYSIS.md); --strict-baseline also
+# fails on stale baseline entries, rule-hash mismatches, and stale
+# sync-ok waivers so the debt ledger can only shrink.  benchmarks/ is
+# report-only against its own committed baseline: harness code gets
+# linted and diffed, but doesn't gate.
 lint:
 	python -m quiver_tpu.analysis --strict-baseline quiver_tpu bench.py
+	python -m quiver_tpu.analysis --report-only \
+		--baseline quiverlint.bench.baseline.json benchmarks
 
-# quick suite + chaos harness under the lock-witness sanitizer
-# (QUIVER_SANITIZE=1 wraps threading.Lock/RLock; docs/STATIC_ANALYSIS.md)
+# quick suite + chaos + mesh harnesses under both runtime witnesses
+# (QUIVER_SANITIZE=1 wraps threading.Lock/RLock AND the device->host
+# coercion points; docs/STATIC_ANALYSIS.md)
 sanitize:
 	QUIVER_SANITIZE=1 python -m pytest tests/ -m "not slow" -q
 	QUIVER_SANITIZE=1 python -m pytest tests/ -m chaos -q
+	QUIVER_SANITIZE=1 python -m pytest tests/ -m mesh -q
 
 # WAL / checkpoint / program-registry durability suite (docs/RECOVERY.md)
 recovery:

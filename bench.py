@@ -612,6 +612,7 @@ def bench_sampling(topo, batch_size, sizes, iters, gather_mode,
         int(sum(int(np.asarray(b.mask).sum()) for b in batch.layers))
         for batch in batches
     )
+    # quiverlint: sync-ok[bench harness readback after the timed loop]
     frontier = float(np.mean([int(b.num_nodes) for b in batches]))
     seps = edges / dt
     log(f"sampling dedup={dedup}: {iters}x B={batch_size} fanout {sizes} "
@@ -932,6 +933,7 @@ def bench_e2e(topo, dim, classes, batch_size, steps, dedup="none",
     tx = optax.adam(3e-3)
 
     b0 = sampler.sample(np.arange(batch_size, dtype=np.int32))
+    # quiverlint: sync-ok[one-time warmup readback to shape model init]
     x0 = feature[np.asarray(b0.n_id)]
     params = model.init(_mk(0), x0, b0.layers)
     state = TrainState.create(params, tx)
@@ -1011,6 +1013,7 @@ def _serving_setup(topo, dim, classes, hidden, gather_mode="auto"):
                       cache_unit="rows").from_cpu_tensor(feat)
     model = GraphSAGE(hidden=hidden, out_dim=classes, num_layers=2)
     b0 = sampler.sample(np.arange(8, dtype=np.int32))
+    # quiverlint: sync-ok[one-time warmup readback to shape model init]
     x0 = feature[np.asarray(b0.n_id)]
     params = model.init(_mk(0), x0, b0.layers)
     def _apply_eval(p, x, blocks):

@@ -242,6 +242,22 @@ def _m_mesh_gather() -> float:
     return (time.perf_counter() - t0) / 10 * 1e3
 
 
+def _m_quiverlint_run() -> float:
+    """ms for one full quiverlint pass over the lint targets — parse,
+    ONE shared Program build, every per-file and program rule (QT001..
+    QT015 incl. the staging-dataflow fixpoint).  The v3 one-parse
+    architecture is only honest if whole-repo analysis stays cheap
+    enough for tier-1; this metric is the receipt."""
+    from quiver_tpu.analysis import analyze_paths
+
+    t0 = time.perf_counter()
+    res = analyze_paths(["quiver_tpu", "bench.py"], root=_REPO)
+    dt = time.perf_counter() - t0
+    if res.errors:
+        raise RuntimeError(f"lint errors: {res.errors[:3]}")
+    return dt * 1e3
+
+
 METRICS: Dict[str, Callable[[], float]] = {
     "wal_append": _m_wal_append,
     "spans": _m_spans,
@@ -251,6 +267,7 @@ METRICS: Dict[str, Callable[[], float]] = {
     "fleet_trace_stamp": _m_fleet_trace_stamp,
     "fleet_router_off": _m_fleet_router_off,
     "mesh_gather": _m_mesh_gather,
+    "quiverlint_run": _m_quiverlint_run,
 }
 
 

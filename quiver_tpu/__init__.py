@@ -86,6 +86,15 @@ from .serving import (
     InferenceServer_Debug,
 )
 
+if _os.environ.get("QUIVER_SANITIZE") == "1":
+    # Device-transfer witness (quiverlint v3's dynamic half) installs at
+    # the END of import — unlike the lock witness it wraps jax's array
+    # type, which must exist first.  Arms the `staging.no_sync()` region
+    # gate as a side effect.
+    from .analysis import transfer_witness as _transfer_witness
+
+    _transfer_witness.install()
+
 __version__ = "0.1.0"
 
 __all__ = [

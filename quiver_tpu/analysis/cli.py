@@ -24,7 +24,10 @@ def build_parser() -> argparse.ArgumentParser:
         prog="python -m quiver_tpu.analysis",
         description="quiverlint: TPU hot-path static analysis "
                     "(QT001 host sync, QT002 retrace hazards, QT003 lock "
-                    "discipline, QT004 import layering, QT005 hygiene)",
+                    "discipline, QT004 import layering, QT005 hygiene; "
+                    "v2 whole-program concurrency QT008-QT010; v3 staging "
+                    "dataflow QT013 interprocedural sync, QT014 cache-key "
+                    "bounds, QT015 collective discipline)",
     )
     p.add_argument("paths", nargs="*", default=["quiver_tpu"],
                    help="files or directories to lint "
@@ -42,7 +45,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="also fail when the baseline holds stale entries "
                         "no longer reported (fixed debt must be removed "
                         "from the baseline, not left to absorb the next "
-                        "regression)")
+                        "regression), when a baseline entry was recorded "
+                        "under a since-edited rule implementation "
+                        "(rule-hash mismatch), or when a sync-ok waiver "
+                        "no longer suppresses anything")
+    p.add_argument("--report-only", action="store_true",
+                   help="print findings but always exit 0 (except on "
+                        "internal errors) — coverage mode for paths "
+                        "outside the enforced set")
     p.add_argument("--write-baseline", action="store_true",
                    help="record current findings as the accepted baseline "
                         "and exit 0")
@@ -85,16 +95,23 @@ def main(argv: Optional[List[str]] = None) -> int:
     known = []
     new = result.findings
     stale = []
+    mismatched = []
     if not args.no_baseline and baseline_path.exists():
         try:
-            accepted = baseline_mod.load(baseline_path)
+            entries = baseline_mod.load_entries(baseline_path)
         except (ValueError, json.JSONDecodeError, OSError) as e:
             print(f"quiverlint: error: bad baseline {baseline_path}: {e}",
                   file=sys.stderr)
             return 2
+        accepted = [f for f, _ in entries]
         new, known = baseline_mod.partition(result.findings, accepted)
         if args.strict_baseline:
+            from .rules import rule_fingerprints
+
             stale = baseline_mod.stale(result.findings, accepted)
+            mismatched = baseline_mod.hash_mismatches(
+                entries, rule_fingerprints())
+    stale_sync = result.stale_sync_ok if args.strict_baseline else []
 
     if args.format == "json":
         print(json.dumps({
@@ -102,6 +119,12 @@ def main(argv: Optional[List[str]] = None) -> int:
             "baselined": [f.to_dict() for f in known],
             "suppressed": [f.to_dict() for f in result.suppressed],
             "stale": [f.to_dict() for f in stale],
+            "rule_hash_mismatch": [
+                dict(f.to_dict(), recorded_hash=h, current_hash=cur)
+                for f, h, cur in mismatched],
+            "stale_sync_ok": [
+                {"path": p, "line": ln, "reason": r}
+                for p, ln, r in stale_sync],
             "files": result.files,
             "errors": result.errors,
         }, indent=2))
@@ -113,6 +136,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         for f in stale:
             print(f"stale baseline entry (no longer reported): "
                   f"{f.rule} {f.path} [{f.scope}] {f.snippet!r}")
+        for f, h, cur in mismatched:
+            print(f"baseline entry recorded under edited rule logic: "
+                  f"{f.rule} {f.path} [{f.scope}] (recorded {h}, "
+                  f"current {cur}) — re-record the baseline")
+        for p, ln, r in stale_sync:
+            print(f"stale sync-ok waiver (suppresses nothing): "
+                  f"{p}:{ln} [{r}]")
         if args.show_suppressed:
             for f in result.suppressed:
                 print(f"suppressed: {f.format()}")
@@ -121,8 +151,12 @@ def main(argv: Optional[List[str]] = None) -> int:
               f"suppressed across {result.files} file(s)"
               + (f", {len(stale)} stale baseline entr"
                  f"{'y' if len(stale) == 1 else 'ies'}"
+                 f", {len(mismatched)} rule-hash mismatch(es)"
+                 f", {len(stale_sync)} stale sync-ok waiver(s)"
                  if args.strict_baseline else ""))
 
     if result.errors:
         return 2
-    return 1 if (new or stale) else 0
+    if args.report_only:
+        return 0
+    return 1 if (new or stale or mismatched or stale_sync) else 0

@@ -1,0 +1,145 @@
+"""QT013 — interprocedural host sync.
+
+QT001 is deliberately local: it tracks names assigned from ``jnp.*`` /
+``jax.*`` calls *within one function* and flags host casts of those.
+The syncs that actually bite in this codebase cross function
+boundaries — ``out = self._fused_forward(padded)`` returns a live
+device array from three calls away, and the ``np.asarray(out)`` on the
+next line is invisible to QT001.  QT013 reads the solved staging
+dataflow (:mod:`..staging.dataflow`) instead: any value whose
+residency fixpoint is DEVICE *and* whose device-ness originated in a
+hot module (the sampler -> gather -> serve pipeline) is flagged at
+every coercion point —
+
+  * host casts: ``int()`` / ``float()`` / ``bool()``,
+  * materializers: ``np.asarray()`` / ``np.array()``,
+  * sync methods: ``.item()`` / ``.tolist()``,
+  * implicit bool: ``if x:`` / ``while x:`` / ``not x`` / ``x and y``
+    / ``assert x`` — each one compiles to ``bool(x)``, a blocking
+    device round-trip jax will happily perform for you.
+
+Intentional syncs at a design boundary (a serving response leaving the
+process, a bench harness checksum) carry a written waiver::
+
+    out = np.asarray(dev)  # quiverlint: sync-ok[response boundary]
+
+``sync-ok`` is audited: a waiver that no longer suppresses anything is
+*stale* and fails ``--strict-baseline`` (see ``analyze_paths``), so
+the escape hatch can't outlive the sync it excused.
+
+Hot modules stay QT001's territory for purely-local flows (a name
+assigned from ``jnp.*`` in the same function) so one sync is never
+reported twice under two codes.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from ..core import (
+    Finding,
+    ModuleContext,
+    ProgramRule,
+    dotted_call_name,
+)
+
+_CASTS = {"int", "float", "bool"}
+_MATERIALIZE = {"np.asarray", "np.array", "numpy.asarray", "numpy.array"}
+_SYNC_METHODS = {"item", "tolist"}
+
+
+class InterproceduralHostSyncRule(ProgramRule):
+    code = "QT013"
+    name = "interprocedural-host-sync"
+    description = ("host coercion (cast / np.asarray / .item / implicit "
+                   "bool) of a device value that crossed a function "
+                   "boundary from a hot-path producer")
+
+    def check_program(self, ctxs: Sequence[ModuleContext],
+                      ) -> Iterator[Finding]:
+        from ..staging.dataflow import DEVICE, build_dataflow
+        from .qt001_host_sync import _is_device_call, _tracked_names
+
+        df = build_dataflow(ctxs)
+        out: List[Finding] = []
+        seen: Set[Tuple[str, int, int, str]] = set()
+
+        for fi in df.prog.functions.values():
+            ctx = fi.ctx
+            hot = ctx.is_hot()
+            tracked: Optional[Set[str]] = None  # QT001's local set, lazy
+
+            def local_territory(arg: ast.AST) -> bool:
+                """True when QT001 already owns this sync (hot module,
+                purely local device provenance).  Mirrors QT001's own
+                ownership test: any device call or tracked name
+                anywhere inside the coerced expression."""
+                nonlocal tracked
+                if not hot:
+                    return False
+                if any(_is_device_call(s) for s in ast.walk(arg)):
+                    return True
+                if tracked is None:
+                    tracked = _tracked_names(fi.node)
+                return any(isinstance(s, ast.Name) and s.id in tracked
+                           for s in ast.walk(arg))
+
+            def emit(node: ast.AST, arg: ast.AST, kind: str, msg: str,
+                     env: Dict) -> None:
+                v = df.classify(fi, arg, env)
+                if v is None or v.cls != DEVICE or not v.hot:
+                    return
+                if local_territory(arg):
+                    return
+                key = (ctx.relpath, node.lineno, node.col_offset, kind)
+                if key in seen:
+                    return
+                seen.add(key)
+                out.append(ctx.finding(self.code, node, msg))
+
+            def visit(node: ast.AST, env: Dict) -> None:
+                if isinstance(node, ast.Call):
+                    name = dotted_call_name(node.func)
+                    if name in _CASTS and node.args:
+                        emit(node, node.args[0], "cast",
+                             f"`{name}()` of a device value produced in a "
+                             f"hot path forces a blocking device->host "
+                             f"sync (crossed a function boundary; waive "
+                             f"an intentional boundary with "
+                             f"`# quiverlint: sync-ok[reason]`)", env)
+                    elif name in _MATERIALIZE and node.args:
+                        emit(node, node.args[0], "cast",
+                             f"`{name}()` materializes a hot-path device "
+                             f"value on host — a full transfer per call "
+                             f"(waive an intentional response boundary "
+                             f"with `# quiverlint: sync-ok[reason]`)", env)
+                    elif (isinstance(node.func, ast.Attribute)
+                          and node.func.attr in _SYNC_METHODS
+                          and not node.args):
+                        emit(node, node.func.value, "cast",
+                             f"`.{node.func.attr}()` syncs a hot-path "
+                             f"device value to host", env)
+                elif isinstance(node, (ast.If, ast.While)):
+                    emit(node, node.test, "bool",
+                         "implicit bool() of a hot-path device value — "
+                         "branching on device data blocks on a transfer; "
+                         "hoist the decision to host metadata or shape "
+                         "logic", env)
+                elif isinstance(node, ast.Assert):
+                    emit(node, node.test, "bool",
+                         "assert on a hot-path device value forces an "
+                         "implicit bool() sync", env)
+                elif (isinstance(node, ast.UnaryOp)
+                      and isinstance(node.op, ast.Not)):
+                    emit(node, node.operand, "bool",
+                         "`not` on a hot-path device value forces an "
+                         "implicit bool() sync", env)
+                elif isinstance(node, ast.IfExp):
+                    emit(node, node.test, "bool",
+                         "conditional expression on a hot-path device "
+                         "value forces an implicit bool() sync", env)
+
+            df.replay(fi, visit)
+
+        yield from out

@@ -1,0 +1,364 @@
+"""QT015 — collective discipline inside ``shard_map`` / ``pmap`` bodies.
+
+The mesh tier's correctness story rests on three structural facts
+(docs/SHARDING.md):
+
+  1. every collective names an axis the enclosing :class:`Mesh`
+     actually declares — a typo'd axis name surfaces at trace time at
+     best, or silently binds a different mesh dimension at worst;
+  2. the halo combines are *bit-exact*: cross-shard reductions of
+     float payloads use the ``pmax``-sentinel formulation, never
+     ``psum`` (float addition is order-sensitive across shard
+     layouts); ``psum`` is reserved for integer counts;
+  3. one executable serves all N shards — a collective whose operand
+     shape is data-dependent per shard (boolean-mask subscripts,
+     ``nonzero`` / ``unique``) breaks SPMD shape agreement.
+
+QT015 checks all three statically.  It finds every ``shard_map`` /
+``pmap`` call site, resolves the body callable through PR 7's
+:class:`Program`, and walks the body's collectives
+(``jax.lax.psum`` / ``pmax`` / ... ).  Axis-name operands resolve
+through locals, closures, constructor-frozen ``self`` attributes and
+cross-module constants (``SHARD_AXIS``); declared axes are harvested
+from every ``Mesh(...)`` / ``make_mesh(...)`` construction in the
+program.  The float-``psum`` check applies only inside
+``LintConfig.bitexact_modules`` (default: the mesh tier), where a
+``psum`` operand must be *provably integer* — an ``.astype(jnp.int32)``,
+an integer literal, a comparison, or a composition of those.
+
+Unresolvable axis names or operands are skipped, not flagged: this
+rule's findings must each be actionable.
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from ..core import Finding, ModuleContext, ProgramRule, dotted_call_name
+
+_COLLECTIVES = {
+    "psum", "pmax", "pmin", "pmean", "all_gather", "ppermute",
+    "axis_index", "all_to_all", "psum_scatter", "pshuffle",
+}
+# collectives taking a reduced operand whose shape must agree per shard
+_REDUCING = _COLLECTIVES - {"axis_index"}
+_WRAPPERS = {"shard_map", "pmap"}
+_INT_PREFIXES = ("int", "uint", "bool")
+_SHAPE_POLYMORPHIC = {"nonzero", "unique", "flatnonzero", "argwhere"}
+
+
+def _leaf(dotted: Optional[str]) -> Optional[str]:
+    return dotted.split(".")[-1] if dotted else None
+
+
+class CollectiveDisciplineRule(ProgramRule):
+    code = "QT015"
+    name = "collective-discipline"
+    description = ("shard_map/pmap body collectives: undeclared axis "
+                   "names, float psum in bit-exactness-contract modules, "
+                   "per-shard data-dependent operand shapes")
+
+    def check_program(self, ctxs: Sequence[ModuleContext],
+                      ) -> Iterator[Finding]:
+        from ..concurrency import build_program
+
+        prog = build_program(ctxs)
+        axes = _declared_axes(prog, ctxs)
+        bitexact = tuple(getattr(ctxs[0].config, "bitexact_modules", ())
+                         if ctxs else ())
+
+        bodies: List = []          # FuncInfo of each collective body
+        seen: Set[str] = set()
+        for fi in prog.functions.values():
+            for node in ast.walk(fi.node):
+                if not isinstance(node, ast.Call) or not node.args:
+                    continue
+                if _leaf(dotted_call_name(node.func)) not in _WRAPPERS:
+                    continue
+                callee = prog.resolve_callable(fi, node.args[0])
+                if callee is None or callee in seen:
+                    continue
+                body = prog.functions.get(callee)
+                if body is not None:
+                    seen.add(callee)
+                    bodies.append(body)
+
+        for body in bodies:
+            hot = _match_any(body.ctx.relpath, bitexact)
+            for node in ast.walk(body.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                dotted = dotted_call_name(node.func)
+                leaf = _leaf(dotted)
+                if leaf not in _COLLECTIVES or not dotted or \
+                        "lax" not in dotted.split("."):
+                    continue
+                yield from self._check_axis(prog, body, node, leaf, axes)
+                if leaf == "psum" and hot and node.args:
+                    yield from self._check_psum(prog, body, node)
+                if leaf in _REDUCING and node.args:
+                    yield from self._check_shape(body, node, leaf)
+
+    # -- axis names ------------------------------------------------------
+
+    def _check_axis(self, prog, body, node: ast.Call, leaf: str,
+                    axes: Set[str]) -> Iterator[Finding]:
+        if not axes:
+            return      # no Mesh declared anywhere in the linted set
+        axis_expr: Optional[ast.AST] = None
+        if len(node.args) > 1:
+            axis_expr = node.args[1]
+        elif leaf == "axis_index" and node.args:
+            axis_expr = node.args[0]
+        for kw in node.keywords:
+            if kw.arg in ("axis_name", "axis"):
+                axis_expr = kw.value
+        if axis_expr is None:
+            return
+        for name in _axis_strings(prog, body, axis_expr):
+            if name not in axes:
+                yield body.ctx.finding(
+                    self.code, node,
+                    f"collective `{leaf}` names axis '{name}' but no "
+                    f"Mesh in the program declares it (declared: "
+                    f"{', '.join(sorted(axes))})")
+
+    # -- bit-exactness ---------------------------------------------------
+
+    def _check_psum(self, prog, body, node: ast.Call) -> Iterator[Finding]:
+        operand = node.args[0]
+        if _provably_int(body, operand, set()):
+            return
+        yield body.ctx.finding(
+            self.code, node,
+            f"`psum` over `{ast.unparse(operand)}` in a bit-exactness-"
+            f"contract module: float psum is reduction-order-sensitive "
+            f"across shard layouts — use the pmax-sentinel combine for "
+            f"payloads, or make integer counts provable with "
+            f"`.astype(jnp.int32)`")
+
+    # -- shape agreement -------------------------------------------------
+
+    def _check_shape(self, body, node: ast.Call,
+                     leaf: str) -> Iterator[Finding]:
+        operand = node.args[0]
+        reason = _shape_data_dependent(body, operand)
+        if reason:
+            yield body.ctx.finding(
+                self.code, node,
+                f"`{leaf}` operand `{ast.unparse(operand)}` has a "
+                f"data-dependent per-shard shape ({reason}) — SPMD "
+                f"collectives need every shard to present the same "
+                f"shape; pad to a static bucket first")
+
+
+# ---------------------------------------------------------------------------
+# declared axes: every Mesh(...) / make_mesh(...) construction
+
+_MESH_CTORS = {"Mesh", "make_mesh", "build_mesh"}
+
+
+def _declared_axes(prog, ctxs: Sequence[ModuleContext]) -> Set[str]:
+    axes: Set[str] = set()
+    for fi in prog.functions.values():
+        for node in ast.walk(fi.node):
+            if not isinstance(node, ast.Call):
+                continue
+            if _leaf(dotted_call_name(node.func)) not in _MESH_CTORS:
+                continue
+            exprs = list(node.args) + [kw.value for kw in node.keywords
+                                       if kw.arg in ("axis_names", None)]
+            for e in exprs:
+                axes.update(_axis_strings(prog, fi, e))
+    # module-level Mesh constructions (rare but legal)
+    for ctx in ctxs:
+        for node in ast.walk(ctx.tree):
+            if (isinstance(node, ast.Call)
+                    and _leaf(dotted_call_name(node.func)) in _MESH_CTORS):
+                for e in list(node.args) + [kw.value
+                                            for kw in node.keywords]:
+                    for sub in ast.walk(e):
+                        if isinstance(sub, ast.Constant) \
+                                and isinstance(sub.value, str):
+                            axes.add(sub.value)
+    return axes
+
+
+def _axis_strings(prog, fi, expr: ast.AST,
+                  depth: int = 0) -> Iterator[str]:
+    """Every axis-name string ``expr`` can denote, resolved through
+    locals, closures, ctor-frozen self attributes and module constants.
+    Yields nothing when unresolvable (callers must skip, not flag)."""
+    if depth > 8:
+        return
+    if isinstance(expr, ast.Constant):
+        if isinstance(expr.value, str):
+            yield expr.value
+        return
+    if isinstance(expr, (ast.Tuple, ast.List)):
+        for e in expr.elts:
+            yield from _axis_strings(prog, fi, e, depth + 1)
+        return
+    if isinstance(expr, ast.Name):
+        f = fi
+        while f is not None:
+            for v in _local_values(f, expr.id):
+                yield from _axis_strings(prog, f, v, depth + 1)
+                return
+            f = getattr(f, "parent", None)
+        yield from _module_const_strings(prog, fi.ctx, expr.id)
+        return
+    if isinstance(expr, ast.Attribute):
+        recv_cls = None
+        if isinstance(expr.value, ast.Name) and expr.value.id == "self" \
+                and fi.cls is not None:
+            recv_cls = fi.cls.key
+        else:
+            recv_cls = prog.receiver_class(fi, expr.value)
+        if recv_cls is not None:
+            for ci in prog._mro(recv_cls):
+                for m in ci.methods.values():
+                    for node in ast.walk(m.node):
+                        if not isinstance(node, ast.Assign):
+                            continue
+                        for t in node.targets:
+                            if (isinstance(t, ast.Attribute)
+                                    and isinstance(t.value, ast.Name)
+                                    and t.value.id == "self"
+                                    and t.attr == expr.attr):
+                                yield from _axis_strings(
+                                    prog, m, node.value, depth + 1)
+        return
+
+
+def _local_values(fi, name: str) -> Iterator[ast.AST]:
+    from ..staging.dataflow import ordered_nodes
+
+    for node in ordered_nodes(fi.node):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == name:
+                    yield node.value
+
+
+def _module_const_strings(prog, ctx, name: str) -> Iterator[str]:
+    mod = prog.modules.get(ctx.module)
+    if mod is not None and name in mod.from_names:
+        m, a = mod.from_names[name]
+        target = prog.modules.get(m) or prog.modules.get(f"{m}.{a}")
+        if target is not None:
+            yield from _module_body_strings(target.ctx, a)
+            return
+    yield from _module_body_strings(ctx, name)
+
+
+def _module_body_strings(ctx, name: str) -> Iterator[str]:
+    for stmt in ctx.tree.body:
+        if isinstance(stmt, ast.Assign):
+            for t in stmt.targets:
+                if isinstance(t, ast.Name) and t.id == name \
+                        and isinstance(stmt.value, ast.Constant) \
+                        and isinstance(stmt.value.value, str):
+                    yield stmt.value.value
+
+
+# ---------------------------------------------------------------------------
+# provably-integer operands
+
+def _provably_int(fi, expr: ast.AST, visited: Set[str]) -> bool:
+    if isinstance(expr, ast.Constant):
+        return isinstance(expr.value, (int, bool)) \
+            and not isinstance(expr.value, float)
+    if isinstance(expr, ast.Compare):
+        return True                                # bool array
+    if isinstance(expr, ast.BoolOp):
+        return all(_provably_int(fi, v, visited) for v in expr.values)
+    if isinstance(expr, ast.BinOp):
+        return (_provably_int(fi, expr.left, visited)
+                and _provably_int(fi, expr.right, visited))
+    if isinstance(expr, ast.UnaryOp):
+        return _provably_int(fi, expr.operand, visited)
+    if isinstance(expr, ast.Subscript):
+        return _provably_int(fi, expr.value, visited)
+    if isinstance(expr, ast.Call):
+        # .astype(jnp.int32) on any receiver, including subscripts the
+        # dotted-name walk can't cross
+        if (isinstance(expr.func, ast.Attribute)
+                and expr.func.attr == "astype" and expr.args):
+            return _int_dtype(expr.args[0])
+        dotted = dotted_call_name(expr.func)
+        leaf = _leaf(dotted)
+        if dotted and dotted.startswith(("jnp.", "np.", "jax.numpy.")):
+            if leaf and leaf.startswith(_INT_PREFIXES):
+                return True                        # jnp.int32(x) etc.
+            for kw in expr.keywords:
+                if kw.arg == "dtype" and _int_dtype(kw.value):
+                    return True
+            if leaf == "where" and len(expr.args) == 3:
+                return (_provably_int(fi, expr.args[1], visited)
+                        and _provably_int(fi, expr.args[2], visited))
+            if leaf in ("sum", "count_nonzero", "argmax", "argmin",
+                        "searchsorted", "arange", "argsort") \
+                    and expr.args:
+                if leaf == "sum":
+                    return _provably_int(fi, expr.args[0], visited)
+                return leaf != "arange" or all(
+                    _provably_int(fi, a, visited) for a in expr.args)
+        return False
+    if isinstance(expr, ast.Name):
+        if expr.id in visited:
+            return False
+        visited.add(expr.id)
+        f = fi
+        while f is not None:
+            vals = list(_local_values(f, expr.id))
+            if vals:
+                return all(_provably_int(f, v, visited) for v in vals)
+            f = getattr(f, "parent", None)
+        return False
+    return False
+
+
+def _int_dtype(expr: ast.AST) -> bool:
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+        return expr.value.startswith(_INT_PREFIXES)
+    dotted = dotted_call_name(expr)
+    leaf = _leaf(dotted)
+    return bool(leaf) and leaf.startswith(_INT_PREFIXES)
+
+
+# ---------------------------------------------------------------------------
+# per-shard shape dependence
+
+def _shape_data_dependent(fi, operand: ast.AST) -> Optional[str]:
+    for sub in ast.walk(operand):
+        if isinstance(sub, ast.Call):
+            leaf = _leaf(dotted_call_name(sub.func))
+            if leaf in _SHAPE_POLYMORPHIC:
+                return f"`{leaf}()` yields a data-dependent length"
+            if leaf == "where" and len(sub.args) == 1:
+                return "single-argument `where()` yields a " \
+                       "data-dependent length"
+        if isinstance(sub, ast.Subscript) and _is_mask_slice(fi,
+                                                            sub.slice):
+            return "boolean-mask subscript selects a data-dependent " \
+                   "row count"
+    return None
+
+
+def _is_mask_slice(fi, sl: ast.AST) -> bool:
+    if isinstance(sl, ast.Compare):
+        return True
+    if isinstance(sl, ast.Name):
+        f = fi
+        while f is not None:
+            for v in _local_values(f, sl.id):
+                return isinstance(v, ast.Compare)
+            f = getattr(f, "parent", None)
+    return False
+
+
+def _match_any(relpath: str, patterns: Tuple[str, ...]) -> bool:
+    return any(fnmatch.fnmatch(relpath, p) for p in patterns)

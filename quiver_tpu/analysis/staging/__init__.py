@@ -1,0 +1,22 @@
+"""quiverlint v3 staging tier — residency dataflow + no-sync regions.
+
+Two halves, one contract ("the frontier never leaves the device"):
+
+* **Static** — :mod:`.dataflow` classifies every value DEVICE / HOST /
+  EITHER interprocedurally over PR 7's :class:`Program` model; the
+  QT013/QT014/QT015 rules read the solve.  Import it explicitly
+  (``from quiver_tpu.analysis.staging import dataflow``) — it pulls in
+  the whole-program machinery and has no business on a serving import
+  path.
+* **Runtime** — :mod:`.regions` exposes :func:`no_sync`, the region
+  marker the hot paths wrap around their device-resident spans, and is
+  what this package re-exports: the library-facing surface must stay a
+  few dozen lines of stdlib with a one-global-read off switch.
+
+The runtime enforcement lives in
+:mod:`quiver_tpu.analysis.transfer_witness` (``QUIVER_SANITIZE=1``).
+"""
+
+from .regions import active, no_sync, on
+
+__all__ = ["active", "no_sync", "on"]

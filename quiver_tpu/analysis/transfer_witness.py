@@ -1,0 +1,308 @@
+"""Runtime device-transfer witness — the dynamic half of quiverlint v3.
+
+QT013 proves over the static call graph that hot paths never coerce a
+device value to host; this module watches the transfers the process
+*actually* performs.  With ``QUIVER_SANITIZE=1`` in the environment,
+``quiver_tpu`` installs the witness right after jax finishes importing
+(the lock witness installs *before* — this one needs the array type to
+exist), wrapping every device-array-to-host coercion point:
+
+* ``jax.device_get`` — the explicit transfer entry point;
+* ``ArrayImpl.item`` / ``ArrayImpl.tolist`` — scalar/list readback;
+* ``ArrayImpl.__bool__`` / ``__int__`` / ``__float__`` / ``__index__``
+  — the implicit coercions an ``if x:`` or ``int(x)`` performs;
+* ``numpy.asarray`` / ``numpy.array`` — materialization.  These are
+  wrapped at *module* level because jax arrays satisfy numpy's buffer
+  protocol, so a class-level ``__array__`` patch never fires for them
+  (``__array__`` is wrapped too, for the dispatch paths that do use
+  it).
+
+Every observed transfer is attributed: the
+``sanitize_host_transfers_total{site}`` counter ticks, and when a
+flight-recorder trace (or the always-on timeline) is live the transfer
+lands on it as a ``host_transfer`` event, so a trace of a slow request
+shows exactly where it blocked on the device.
+
+A transfer is a *violation* only inside a declared no-sync region
+(``with staging.no_sync("serving device loop"):`` — see
+:mod:`quiver_tpu.analysis.staging.regions`).  Violations are
+**recorded, never raised**: the suite keeps running and the conftest
+harness fails the owning test from :func:`drain`, exactly like the lock
+witness.  With the env var unset this module is never imported, the
+region gate stays a single-global-read no-op, and numpy/jax are
+untouched — the zero-overhead contract ``tests/test_transfer_witness.py``
+pins.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import traceback
+from typing import Callable, List, Optional, Tuple
+
+from .staging import regions
+
+__all__ = [
+    "Transfer", "Violation", "drain", "install", "installed",
+    "transfers", "uninstall", "violations",
+]
+
+_INTERNAL_FILES: Tuple[str, ...] = (__file__,)
+
+_MISSING = object()
+
+
+class Violation:
+    """One recorded sanitizer finding (kind, message, capture stack)."""
+
+    __slots__ = ("kind", "message", "stack", "thread")
+
+    def __init__(self, kind: str, message: str):
+        self.kind = kind
+        self.message = message
+        self.thread = threading.current_thread().name
+        self.stack = "".join(traceback.format_stack(sys._getframe(2), 8))
+
+    def __repr__(self):
+        return f"Violation({self.kind}: {self.message} [{self.thread}])"
+
+
+class Transfer:
+    """One observed device-to-host transfer (attribution record)."""
+
+    __slots__ = ("site", "where", "region", "thread")
+
+    def __init__(self, site: str, where: str, region: Optional[str]):
+        self.site = site
+        self.where = where
+        self.region = region
+        self.thread = threading.current_thread().name
+
+    def __repr__(self):
+        tail = f" in no-sync region `{self.region}`" if self.region else ""
+        return f"Transfer({self.site} at {self.where}{tail})"
+
+
+class _State:
+    def __init__(self):
+        self.lock = threading.Lock()      # guards the two lists
+        self.violations: List[Violation] = []
+        self.transfers: List[Transfer] = []
+        # (owner, name, original-or-_MISSING) restore records
+        self.saved: List[Tuple[object, str, object]] = []
+        self.tls = threading.local()      # .busy re-entry depth
+
+
+_state: Optional[_State] = None
+
+
+def _caller_site() -> str:
+    f = sys._getframe(2)
+    for _ in range(16):
+        if f is None:
+            break
+        fn = f.f_code.co_filename
+        if fn not in _INTERNAL_FILES and "<" not in fn[:1]:
+            return f"{fn.rsplit('/', 1)[-1]}:{f.f_lineno}"
+        f = f.f_back
+    return "<unknown>"
+
+
+def _observe(site: str) -> None:
+    """Record one transfer: tick the counter, attribute to any live
+    trace/timeline, and flag it when inside a declared no-sync region.
+    Never raises."""
+    st = _state
+    if st is None:
+        return
+    where = _caller_site()
+    region = regions.active()
+    t = Transfer(site, where, region)
+    with st.lock:
+        st.transfers.append(t)
+        if region is not None:
+            st.violations.append(Violation(
+                "in-region-sync",
+                f"device-to-host transfer via `{site}` at {where} inside "
+                f"no-sync region `{region}` — this path declared it never "
+                f"blocks on the device"))
+    try:
+        from ..telemetry import counter, flightrec, timeline
+
+        counter("sanitize_host_transfers_total", site=site).inc()
+        if flightrec.tracing():
+            flightrec.event("host_transfer",
+                            {"site": site, "where": where,
+                             "region": region})
+        elif timeline.on():
+            timeline.instant("host_transfer", cat="sanitize",
+                             attrs={"site": site, "where": where})
+    except Exception:
+        pass  # telemetry must never break the suite under test
+
+
+def _busy() -> bool:
+    st = _state
+    return st is not None and getattr(st.tls, "busy", 0) > 0
+
+
+class _Busy:
+    """Suppress nested observations: ``jax.device_get`` calling
+    ``np.asarray`` internally is ONE transfer, not two."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        st = _state
+        if st is not None:
+            st.tls.busy = getattr(st.tls, "busy", 0) + 1
+
+    def __exit__(self, *exc):
+        st = _state
+        if st is not None:
+            st.tls.busy = getattr(st.tls, "busy", 1) - 1
+        return False
+
+
+_BUSY = _Busy()
+
+
+def _save(st: _State, owner, name: str) -> object:
+    """Record the pre-patch attribute for uninstall.  Distinguishes
+    'inherited' from 'own' so restore doesn't pin a copied slot."""
+    own = owner.__dict__.get(name, _MISSING) if hasattr(owner, "__dict__") \
+        else _MISSING
+    orig = getattr(owner, name)
+    st.saved.append((owner, name, own if own is not _MISSING else _MISSING))
+    return orig
+
+
+def _wrap_method(st: _State, cls, name: str, site: str) -> bool:
+    if getattr(cls, name, None) is None:
+        return False
+    orig = _save(st, cls, name)
+
+    def wrapped(self, *a, **k):
+        if not _busy():
+            _observe(site)
+        with _BUSY:
+            return orig(self, *a, **k)
+
+    wrapped.__name__ = name
+    wrapped.__qualname__ = f"{cls.__name__}.{name}"
+    try:
+        setattr(cls, name, wrapped)
+    except (AttributeError, TypeError):
+        st.saved.pop()
+        return False
+    return True
+
+
+def install() -> None:
+    """Wrap the device-to-host coercion points and arm the no-sync
+    region gate.  Requires jax importable; idempotent."""
+    global _state
+    if _state is not None:
+        return
+    import jax
+    import numpy
+    from jax._src import array as _jarray
+
+    ArrayImpl = _jarray.ArrayImpl
+    st = _State()
+
+    for name, site in (
+        ("item", ".item()"),
+        ("tolist", ".tolist()"),
+        ("__bool__", "bool()"),
+        ("__int__", "int()"),
+        ("__float__", "float()"),
+        ("__index__", "__index__"),
+        ("__array__", "__array__"),
+    ):
+        _wrap_method(st, ArrayImpl, name, site)
+
+    real_device_get = _save(st, jax, "device_get")
+
+    def device_get(x):
+        if not _busy():
+            _observe("jax.device_get")
+        with _BUSY:
+            return real_device_get(x)
+
+    jax.device_get = device_get
+
+    def _wrap_np(fn: Callable, site: str) -> Callable:
+        def wrapped(*a, **k):
+            if a and isinstance(a[0], ArrayImpl) and not _busy():
+                _observe(site)
+            with _BUSY:
+                return fn(*a, **k)
+
+        wrapped.__name__ = getattr(fn, "__name__", site)
+        return wrapped
+
+    real_asarray = _save(st, numpy, "asarray")
+    real_array = _save(st, numpy, "array")
+    numpy.asarray = _wrap_np(real_asarray, "np.asarray")
+    numpy.array = _wrap_np(real_array, "np.array")
+
+    _state = st
+    regions._ON = True      # arm `staging.no_sync()` region tracking
+
+
+def uninstall() -> None:
+    """Restore every patched attribute and drop recorded state.  The
+    region gate disarms with it (``no_sync`` back to shared no-op)."""
+    global _state
+    st = _state
+    if st is None:
+        return
+    regions._ON = False
+    for owner, name, orig in reversed(st.saved):
+        if orig is _MISSING:
+            # attribute was inherited (or absent) pre-patch: drop ours
+            try:
+                delattr(owner, name)
+            except AttributeError:
+                pass
+        else:
+            setattr(owner, name, orig)
+    st.saved.clear()
+    _state = None
+
+
+def installed() -> bool:
+    return _state is not None
+
+
+def violations() -> List[Violation]:
+    st = _state
+    if st is None:
+        return []
+    with st.lock:
+        return list(st.violations)
+
+
+def transfers() -> List[Transfer]:
+    """The attribution log since install/last drain (tests assert a
+    transfer landed on the right trace through this)."""
+    st = _state
+    if st is None:
+        return []
+    with st.lock:
+        return list(st.transfers)
+
+
+def drain() -> List[Violation]:
+    """Return and clear recorded violations (and the attribution log) —
+    the conftest autouse fixture fails the owning test on any."""
+    st = _state
+    if st is None:
+        return []
+    with st.lock:
+        out = list(st.violations)
+        st.violations.clear()
+        st.transfers.clear()
+        return out

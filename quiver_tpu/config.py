@@ -527,6 +527,10 @@ def resolve_gather_mode(gather_mode: str,
     return resolved
 
 
+# config is frozen once per process, so anything read off it is
+# process-lifetime-finite: cache keys built from config attributes
+# cannot blow up executable cardinality.
+# quiverlint: bucketed[config is frozen once per process]
 def get_config() -> Config:
     global _config
     if _config is None:

@@ -434,6 +434,8 @@ class Feature:
             or get_config().cold_cache_policy
         table = PageTable(self.node_count, self.cache_count, R,
                           pool_pages, policy=policy)
+        # quiverlint: sync-ok[one-time hot-set migration at paging enablement]
+        # (never on the lookup path)
         hot_np = (np.asarray(self.hot) if self.cache_count else None)
         store = PagedStore(table, self.cold, self.cache_count, self.dim,
                            dt, hot_host=hot_np)
@@ -843,6 +845,12 @@ class Feature:
                 def fn(hot, hot_idx, cold_rows, cold_pos):
                     out = jnp.take(hot, hot_idx, axis=0)
                     return out.at[cold_pos].set(cold_rows, mode="drop")
+            # quiverlint: ignore[QT014] -- B is one-executable-per-batch-
+            # size by design (serving pads upstream via _pad_ids); the
+            # bucket component is always produced by _pow2_bucket /
+            # _fresh_bucket in _stage/_stage_overlay, but rides through
+            # the prefetch dict as an opaque staged tuple, which is
+            # where the symbolic trace loses it.
             self._merge_cache[(B, bucket)] = fn
         return fn
 
@@ -923,6 +931,11 @@ class Feature:
                     interpret=interpret)
                 return jnp.take(out, rank, axis=0)
 
+            # quiverlint: ignore[QT014] -- one executable per batch size
+            # is this path's contract (the whole (B, bucket) grid
+            # collapses to it); B arrives inside the planner's staged
+            # tuple through the duck-typed PagedStore.finish edge, which
+            # the symbolic trace cannot follow.
             self._merge_cache[("paged", B)] = fn
         return fn
 
@@ -940,6 +953,11 @@ class Feature:
             def fn(frames, slots, pages):
                 return frames.at[slots].set(pages, mode="drop")
 
+            # quiverlint: ignore[QT014] -- k_pad is pow2-padded at the
+            # fault site (ops/paged._fault: _pow2_bucket over the miss
+            # count); the call reaches here through the duck-typed
+            # PagedStore._feature receiver, which hides the edge from
+            # the resolver.
             self._merge_cache[("pgfault", k_pad)] = fn
         return fn
 

@@ -220,6 +220,11 @@ class HeteroGraphSageSampler:
         if B not in self._jitted:
             # jit the bound method directly — a fresh lambda here would
             # defeat jax's executable cache if this dict were ever reset
+            # quiverlint: ignore[QT014] -- hetero keys on raw B by
+            # design: seed counts come from the caller's loader, which
+            # fixes the batch size; padding here would ripple through
+            # every per-type frontier shape.  seal()/retrace_budget
+            # guard the steady state.
             self._jitted[B] = jax.jit(self._pipeline)
         if key is None:
             from .utils.rng import make_key

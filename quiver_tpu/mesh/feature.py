@@ -153,6 +153,10 @@ class MeshFeature:
             def fn(frames, slots, pages):
                 return frames.at[slots].set(pages, mode="drop")
 
+            # quiverlint: ignore[QT014] -- k_pad is pow2-padded at the
+            # fault site (ops/paged._fault); the edge runs through the
+            # duck-typed PagedStore._feature -> _ShardFaultFns shim,
+            # which the resolver cannot follow.
             self._cache[("pgfault", k_pad)] = fn
         return fn
 

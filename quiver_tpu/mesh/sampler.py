@@ -30,6 +30,7 @@ from typing import Optional
 import numpy as np
 
 from .. import telemetry
+from ..analysis.staging import no_sync
 from ..ops.sample import SampleOut, sample_neighbors_overlay
 from ..recovery.registry import program_cache
 from .topology import SHARD_AXIS, build_mesh, row_shard, shard_ranges
@@ -118,7 +119,10 @@ class MeshSampler:
                 # the owner's block unchanged; counts sum (others are 0)
                 nb = jax.lax.pmax(nbrs[0], axis)
                 mk = jax.lax.pmax(mask[0].astype(jnp.int32), axis) > 0
-                ct = jax.lax.psum(counts[0], axis)
+                # int32 cast makes the count-sum provably integer (QT015
+                # bit-exactness contract): psum is reserved for counts,
+                # payload rows go through the pmax sentinel above
+                ct = jax.lax.psum(counts[0].astype(jnp.int32), axis)
                 # shard-local edge positions -> global: offset by the
                 # shard's first edge (eid stays -1 where masked)
                 ei = jnp.where(eid[0] >= 0, eid[0] + base[0],
@@ -130,6 +134,11 @@ class MeshSampler:
                 _local, mesh=self.mesh,
                 in_specs=(P(axis), P(axis), P(axis), P(axis), P(axis)),
                 out_specs=(P(), P(), P(), P())))
+            # quiverlint: ignore[QT014] -- raw B is deliberate: the mesh
+            # sampler is bit-identical to the single-device path under
+            # the same key, and padding seeds would change RNG
+            # consumption; serving feeds pow2-padded batches, and
+            # seal()/retrace_budget guard steady-state.
             self._jitted[key] = fn
         return fn
 
@@ -161,7 +170,10 @@ class MeshSampler:
                             tuple(o.eid for o in outs))]
         base = jax.device_put(jnp.asarray(self._edge_base),
                               self._sharding)
-        nb, mk, ct, ei = self._combine_fn(B, k)(*stack, base)
+        # the cross-shard combine dispatches collectives; a host sync
+        # here would serialize the whole mesh per hop
+        with no_sync("mesh combine"):
+            nb, mk, ct, ei = self._combine_fn(B, k)(*stack, base)
         return SampleOut(nbrs=nb, mask=mk, counts=ct, eid=ei)
 
     def stats(self) -> dict:

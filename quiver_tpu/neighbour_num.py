@@ -90,6 +90,7 @@ def generate_neighbour_num(
         indices = indices[:e]
         g = _get_expected_counts_jit()(
             indptr, indices, n=n, sizes=tuple(int(k) for k in sizes))
+        # quiverlint: sync-ok[host-return contract: callers get numpy]
         out = np.asarray(g).astype(np.int64)
     if path is not None:
         np.save(path, out)

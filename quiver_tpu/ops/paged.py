@@ -46,6 +46,7 @@ from typing import Optional, Tuple
 
 import numpy as np
 
+from ..analysis.staging import no_sync
 from .coldcache import ColdRowCache
 
 __all__ = ["PagedStore", "PageTable", "default_page_rows",
@@ -337,7 +338,10 @@ class PagedStore:
         """Run the (cached) paged gather program over a staged plan."""
         (_, frames, blk_pages, blk_np, row_lp, row_off, rank, B) = staged
         fn = feature._paged_fn(B)
-        return fn(frames, blk_pages, blk_np, row_lp, row_off, rank)
+        # the gather itself must dispatch without blocking: callers
+        # decide when (whether) to materialize the result
+        with no_sync("paged gather"):
+            return fn(frames, blk_pages, blk_np, row_lp, row_off, rank)
 
     # ------------------------------------------------------------------
     def invalidate_rows(self, rel_ids: np.ndarray) -> int:

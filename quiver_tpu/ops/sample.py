@@ -500,6 +500,7 @@ def to_ragged(out: SampleOut) -> Tuple[jax.Array, jax.Array]:
     offsets = jnp.concatenate(
         [jnp.zeros(1, jnp.int32), jnp.cumsum(counts)[:-1].astype(jnp.int32)]
     )
+    # quiverlint: sync-ok[ragged export is a host boundary by contract]
     total = int(counts.sum())
     flat_pos = offsets[:, None] + jnp.cumsum(out.mask, axis=1) - 1
     flat = jnp.zeros(total, dtype=jnp.int32)

@@ -80,18 +80,19 @@ class SampledBatch(NamedTuple):
         adjs = []
         n_src = int(self.n_id.shape[0])
         for blk in self.layers:
-            m = np.asarray(blk.mask)
-            nbr = np.asarray(blk.nbr_local)
+            m = np.asarray(blk.mask)  # quiverlint: sync-ok[PyG export boundary]
+            nbr = np.asarray(blk.nbr_local)  # quiverlint: sync-ok[PyG export boundary]
             t, k = m.shape
             row = np.repeat(np.arange(t, dtype=np.int64), k).reshape(t, k)
             col = nbr.astype(np.int64)
             e = m.reshape(-1)
             edge_index = np.stack([col.reshape(-1)[e], row.reshape(-1)[e]])
+            # quiverlint: sync-ok[PyG export boundary]
             e_id = (np.asarray(blk.eid).reshape(-1)[e]
                     if blk.eid is not None else np.empty(0, np.int64))
             adjs.append((edge_index, e_id, (n_src, t)))
             n_src = t  # this layer's targets = next (inner) layer's sources
-        return (np.asarray(self.n_id), self.batch_size, adjs)
+        return (np.asarray(self.n_id), self.batch_size, adjs)  # quiverlint: sync-ok[PyG export boundary]
 
 
 def _sample_pipeline_nodedup(indptr, indices, seeds, key, sizes,
@@ -462,10 +463,10 @@ class GraphSageSampler:
         seeds = np.asarray(seeds)
         out = self.sample_layer(seeds, size, key=key)
         r = self.reindex(seeds, out.nbrs, out.mask)
-        num = int(r.num_nodes)
-        nodes = np.asarray(r.n_id)[:num]
-        m = np.asarray(r.mask)
-        local = np.asarray(r.local_nbrs)
+        num = int(r.num_nodes)  # quiverlint: sync-ok[host subgraph export]
+        nodes = np.asarray(r.n_id)[:num]  # quiverlint: sync-ok[host subgraph export]
+        m = np.asarray(r.mask)  # quiverlint: sync-ok[host subgraph export]
+        local = np.asarray(r.local_nbrs)  # quiverlint: sync-ok[host subgraph export]
         row = np.repeat(np.arange(len(seeds)), out.nbrs.shape[1]).reshape(
             m.shape
         )[m]
@@ -566,6 +567,11 @@ class GraphSageSampler:
         B = seeds.shape[0]
         fn = self._jitted.get(B)
         if fn is None:
+            # quiverlint: ignore[QT014] -- raw B is the sampler's
+            # contract: one executable per seed-batch size, bit-stable
+            # RNG per seed row (padding would consume extra key splits).
+            # Serving pads upstream via _pad_ids; seal()/retrace_budget
+            # guard the steady state.
             fn = self._jitted[B] = self._build_jit(B)
         if key is None:
             from .utils.rng import make_key
@@ -601,6 +607,12 @@ class GraphSageSampler:
               windowed)
         fn = self._jitted.get(jk)
         if fn is None:
+            # quiverlint: ignore[QT014] -- B: same raw-batch-size
+            # contract as the static path.  epad moves only at
+            # compaction/fold (O(graph versions), not O(requests)) and
+            # delta_bucket is _fanout_bucket-padded at snapshot build;
+            # both ride the DeltaSnapshot NamedTuple, whose device-array
+            # provenance the symbolic trace cannot follow.
             fn = self._jitted[jk] = self._build_stream_jit(B, windowed)
         if key is None:
             from .utils.rng import make_key
@@ -637,9 +649,11 @@ class GraphSageSampler:
         any TPU-mode call; always zero without caps or ``dedup='none'``).
         """
         if batch is not None:
+            # quiverlint: sync-ok[deliberate materialization point for drops]
             return None if batch.drops is None else np.asarray(batch.drops)
         if getattr(self, "last_drops", None) is None:
             return None
+        # quiverlint: sync-ok[deliberate materialization point for drops]
         arr = np.asarray(self.last_drops)
         # count into the registry exactly once per sample() call (the
         # batch= form can't dedup across repeat queries, so only the

@@ -38,6 +38,7 @@ from typing import Callable, List, Optional, Sequence
 import numpy as np
 
 from . import telemetry
+from .analysis.staging import no_sync
 from .resilience import chaos
 from .resilience.breaker import CircuitBreaker
 from .resilience.deadline import deadline_for, deadline_scope, \
@@ -493,6 +494,7 @@ class InferenceServer:
         feature.enable_cold_cache()
 
     # -- core per-request paths ---------------------------------------
+    # quiverlint: bucketed[every result length is drawn from BUCKETS]
     def _pad_ids(self, ids: np.ndarray) -> np.ndarray:
         b = _next_bucket(len(ids), self.BUCKETS)
         if len(ids) >= b:  # at the top bucket exactly (chunking caps len)
@@ -526,7 +528,11 @@ class InferenceServer:
             padded = self._pad_ids(chunk)
             if self._fused:
                 t0 = time.perf_counter()
-                out = self._fused_forward(padded)
+                # dispatch must stay async: the readback below is the
+                # ONE sanctioned sync point per chunk
+                with no_sync("serving device loop"):
+                    out = self._fused_forward(padded)
+                # quiverlint: sync-ok[response boundary: one transfer per chunk]
                 outs.append(np.asarray(out)[: len(chunk)])
                 if stages is not None:  # one jit: stages are fused too
                     dt = time.perf_counter() - t0

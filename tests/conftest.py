@@ -52,14 +52,18 @@ if _SANITIZING:
 
     @pytest.fixture(autouse=True)
     def _witness_drain(request):
+        from quiver_tpu.analysis import transfer_witness as _transfer
+
         _witness.drain()  # don't blame this test for prior leftovers
+        _transfer.drain()
         yield
-        vs = _witness.drain()
+        vs = [("lock-witness", v) for v in _witness.drain()]
+        vs += [("transfer-witness", v) for v in _transfer.drain()]
         if vs:
-            lines = [f"  [{v.kind}] {v.message} (thread {v.thread})"
-                     for v in vs]
+            lines = [f"  [{src}:{v.kind}] {v.message} (thread {v.thread})"
+                     for src, v in vs]
             pytest.fail(
-                "lock-witness sanitizer recorded %d violation(s):\n%s"
+                "sanitizer recorded %d violation(s):\n%s"
                 % (len(vs), "\n".join(lines)), pytrace=False)
 
 

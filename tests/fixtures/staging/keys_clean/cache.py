@@ -1,0 +1,24 @@
+"""Clean twin of ``keys_seeded``: the same insertion shape, with the
+key routed through a pow2 bucket — cardinality is log of the largest
+frontier, and QT014 proves it from the helper name.
+"""
+
+from quiver_tpu.recovery.registry import program_cache
+
+
+def _pow2_bucket(n):
+    b = 1
+    while b < n:
+        b *= 2
+    return b
+
+
+class Gather:
+    def __init__(self):
+        self._fns = program_cache("fixture_gather", owner=self)
+
+    def run(self, ids):
+        b = _pow2_bucket(int(ids.shape[0]))
+        if b not in self._fns:
+            self._fns[b] = object()
+        return self._fns[b]

@@ -1,0 +1,17 @@
+"""Executable cache keyed on raw runtime data: every distinct frontier
+length compiles (and retains) a fresh program — QT014's job is to prove
+the key bounded, and here it cannot be.
+"""
+
+from quiver_tpu.recovery.registry import program_cache
+
+
+class Gather:
+    def __init__(self):
+        self._fns = program_cache("fixture_gather", owner=self)
+
+    def run(self, ids):
+        n = int(ids.shape[0])
+        if n not in self._fns:
+            self._fns[n] = object()
+        return self._fns[n]

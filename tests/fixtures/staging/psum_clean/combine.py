@@ -1,0 +1,22 @@
+"""Clean twin of ``psum_seeded``: the payload crosses replicas through
+the order-insensitive pmax-sentinel combine, and the only ``psum`` is a
+provably-integer count — both bit-exact under any shard layout.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+AXIS = "shard"
+
+
+def _combine(x, mask):
+    payload = jax.lax.pmax(x, AXIS)
+    count = jax.lax.psum(mask.astype(jnp.int32), AXIS)
+    return payload, count
+
+
+def gather_all(x, mask, devices):
+    mesh = Mesh(devices, (AXIS,))
+    with mesh:
+        return jax.pmap(_combine, axis_name=AXIS)(x, mask)

@@ -1,0 +1,20 @@
+"""Float ``psum`` inside a ``pmap`` body: cross-replica float addition
+is reduction-order-sensitive, so the result depends on the shard
+layout — a bit-exactness-contract violation QT015 flags when this
+module matches ``bitexact_modules``.
+"""
+
+import jax
+from jax.sharding import Mesh
+
+AXIS = "shard"
+
+
+def _combine(x):
+    return jax.lax.psum(x, AXIS)
+
+
+def gather_all(x, devices):
+    mesh = Mesh(devices, (AXIS,))
+    with mesh:
+        return jax.pmap(_combine, axis_name=AXIS)(x)

@@ -1,0 +1,25 @@
+"""Clean twin of ``sync_seeded``: the same cast-through-helper shape,
+but the sync is an *intentional* epoch boundary and says so — the
+waiver suppresses QT013 and registers with the staleness audit.
+``count_of`` shows the genuinely-host path: a helper returning host
+data may be cast freely.
+"""
+
+import jax.numpy as jnp
+
+
+def _scores(xs):
+    return jnp.asarray(xs).sum()
+
+
+def _sizes(xs):
+    return [len(x) for x in xs]
+
+
+def mean_score(xs):
+    # quiverlint: sync-ok[epoch boundary: one readback per epoch]
+    return float(_scores(xs)) / max(len(xs), 1)
+
+
+def count_of(xs):
+    return int(sum(_sizes(xs)))

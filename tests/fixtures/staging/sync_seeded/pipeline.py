@@ -1,0 +1,17 @@
+"""Interprocedural host sync QT001's per-file view cannot see.
+
+``_scores`` returns a device array; ``mean_score`` coerces it with
+``float()`` one call away.  Nothing on the caller's line mentions jnp,
+so the lexical rule stays quiet — the staging dataflow carries the
+DEVICE class through the return edge and QT013 flags the cast.
+"""
+
+import jax.numpy as jnp
+
+
+def _scores(xs):
+    return jnp.asarray(xs).sum()
+
+
+def mean_score(xs):
+    return float(_scores(xs)) / max(len(xs), 1)

@@ -129,6 +129,38 @@ def test_stale_baseline_entry_fails_only_under_strict(tmp_path):
      "    t0 = time.time()\n"
      "    fn()\n"
      "    return time.time() - t0\n"),
+    # v3: the device value crosses a function boundary, so QT001's
+    # local tracking can't see it — only the staging dataflow can
+    ("quiver_tpu/sampler.py", "QT013",
+     "\n\ndef _inj_gather_scores(xs):\n"
+     "    return jnp.asarray(xs).sum()\n"
+     "\n"
+     "def _inj_mean_score(xs):\n"
+     "    return float(_inj_gather_scores(xs)) / max(len(xs), 1)\n"),
+    # v3: executable cache keyed by a raw batch length — every novel
+    # size compiles a new program (no bucket helper, no directive)
+    ("quiver_tpu/serving.py", "QT014",
+     "\n\nfrom .recovery.registry import program_cache\n"
+     "\n"
+     "class _InjExecCache:\n"
+     "    def __init__(self):\n"
+     "        self._fns = program_cache(\"inj\", owner=self)\n"
+     "\n"
+     "    def get(self, ids):\n"
+     "        n = int(ids.shape[0])\n"
+     "        if n not in self._fns:\n"
+     "            self._fns[n] = object()\n"
+     "        return self._fns[n]\n"),
+    # v3: float psum in a bit-exactness module (mesh/*) — order of
+    # reduction varies with shard layout, breaking the replica contract
+    ("quiver_tpu/mesh/sampler.py", "QT015",
+     "\n\ndef _inj_combine(x):\n"
+     "    import jax\n"
+     "    return jax.lax.psum(x, \"shard\")\n"
+     "\n"
+     "def _inj_allmean(x):\n"
+     "    import jax\n"
+     "    return jax.pmap(_inj_combine, axis_name=\"shard\")(x)\n"),
 ])
 def test_injected_violation_fails_cli(tmp_path, relpath, code, appended):
     root = _repo_copy_with(tmp_path, relpath, appended)

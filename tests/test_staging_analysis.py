@@ -1,0 +1,500 @@
+"""quiverlint v3 staging-dataflow tests (QT013/QT014/QT015 + hygiene).
+
+Three layers, same idiom as ``test_concurrency_analysis.py``:
+
+* dataflow unit tests over tmp_path sources, through the real
+  ``build_dataflow`` model;
+* rule tests over tmp_path sources and the on-disk TP/TN packages in
+  ``tests/fixtures/staging/`` (seeded bugs must report exactly the
+  expected rule, clean twins must stay silent);
+* baseline-hygiene tests: rule-version hash stamps and the sync-ok
+  staleness audit under ``--strict-baseline``.
+"""
+
+import json
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from quiver_tpu.analysis import LintConfig, analyze_paths
+from quiver_tpu.analysis import baseline as baseline_mod
+from quiver_tpu.analysis.concurrency import build_program
+from quiver_tpu.analysis.core import load_contexts
+from quiver_tpu.analysis.rules import rule_fingerprints
+from quiver_tpu.analysis.staging.dataflow import (
+    DEVICE,
+    HOST,
+    build_dataflow,
+)
+
+REPO = Path(__file__).resolve().parents[1]
+FIXTURES = REPO / "tests" / "fixtures" / "staging"
+
+# fixture-scoped config: the fixture packages play the part of hot /
+# bit-exact modules (relpaths are package-rooted when root=FIXTURES)
+FIXTURE_CFG = LintConfig(
+    hot_modules=("sync_seeded/*.py", "sync_clean/*.py", "mod.py",
+                 "hot.py"),
+    bitexact_modules=("psum_seeded/*.py", "psum_clean/*.py", "mod.py"),
+)
+
+
+def run_lint(tmp_path, source, name="mod.py", config=None):
+    p = tmp_path / name
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(textwrap.dedent(source))
+    result = analyze_paths([str(p)], config=config or FIXTURE_CFG,
+                           root=tmp_path)
+    assert result.errors == [], result.errors  # fixture must parse
+    return result
+
+
+def flow_of(tmp_path, source, name="mod.py"):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(source))
+    ctxs = load_contexts([str(p)], root=tmp_path)
+    return build_program(ctxs), build_dataflow(ctxs)
+
+
+def codes(result):
+    return sorted(f.rule for f in result.findings)
+
+
+# ------------------------------------------------------------- dataflow
+class TestDataflow:
+    def test_device_class_crosses_return_edges(self, tmp_path):
+        prog, df = flow_of(tmp_path, """
+            import jax.numpy as jnp
+
+            def make(xs):
+                return jnp.asarray(xs)
+
+            def use(xs):
+                v = make(xs)
+                return v
+        """)
+        use = prog.functions["mod:use"]
+        ret = df.ret.get("mod:make")
+        assert ret is not None and ret.cls == DEVICE
+        import ast
+        name = ast.parse("v").body[0].value
+        v = df.classify(use, name)
+        assert v is not None and v.cls == DEVICE
+
+    def test_metadata_attrs_are_host(self, tmp_path):
+        prog, df = flow_of(tmp_path, """
+            import jax.numpy as jnp
+
+            def shape_of(xs):
+                arr = jnp.asarray(xs)
+                n = arr.shape[0]
+                return n
+        """)
+        ret = df.ret.get("mod:shape_of")
+        assert ret is not None and ret.cls == HOST
+
+    def test_param_join_from_call_sites(self, tmp_path):
+        prog, df = flow_of(tmp_path, """
+            import jax.numpy as jnp
+
+            def sink(v):
+                return v
+
+            def caller(xs):
+                return sink(jnp.asarray(xs))
+        """)
+        p = df.param.get(("mod:sink", "v"))
+        assert p is not None and p.cls == DEVICE
+
+    def test_self_attr_residency_through_methods(self, tmp_path):
+        prog, df = flow_of(tmp_path, """
+            import jax.numpy as jnp
+
+            class Holder:
+                def __init__(self, xs):
+                    self.buf = jnp.asarray(xs)
+
+                def get(self):
+                    return self.buf
+        """)
+        ret = df.ret.get("mod:Holder.get")
+        assert ret is not None and ret.cls == DEVICE
+
+    def test_host_math_stays_host(self, tmp_path):
+        prog, df = flow_of(tmp_path, """
+            def tally(xs):
+                total = len(xs) + 1
+                return total
+        """)
+        ret = df.ret.get("mod:tally")
+        assert ret is not None and ret.cls == HOST
+
+
+# ------------------------------------------------------- QT013 behavior
+class TestInterproceduralSync:
+    def test_cast_of_helper_device_return_flagged(self, tmp_path):
+        r = run_lint(tmp_path, """
+            import jax.numpy as jnp
+
+            def _scores(xs):
+                return jnp.asarray(xs).sum()
+
+            def mean(xs):
+                return float(_scores(xs))
+        """)
+        assert codes(r) == ["QT013"]
+
+    def test_direct_cast_stays_qt001_territory(self, tmp_path):
+        # the same-line jnp cast is QT001's per-file finding; QT013 must
+        # not double-report it
+        r = run_lint(tmp_path, """
+            import jax.numpy as jnp
+
+            def mean(xs):
+                return float(jnp.asarray(xs).sum())
+        """)
+        assert codes(r) == ["QT001"]
+
+    def test_cold_module_origin_not_flagged(self, tmp_path):
+        r = run_lint(tmp_path, name="cold.py", source="""
+            import jax.numpy as jnp
+
+            def _scores(xs):
+                return jnp.asarray(xs).sum()
+
+            def mean(xs):
+                return float(_scores(xs))
+        """)
+        assert r.findings == []
+
+    def test_implicit_bool_coercion_flagged(self, tmp_path):
+        r = run_lint(tmp_path, """
+            import jax.numpy as jnp
+
+            def _mask(xs):
+                return jnp.asarray(xs) > 0
+
+            def any_hit(xs):
+                if _mask(xs).any():
+                    return True
+                return False
+        """)
+        assert codes(r) == ["QT013"]
+
+    def test_sync_ok_waiver_suppresses(self, tmp_path):
+        r = run_lint(tmp_path, """
+            import jax.numpy as jnp
+
+            def _scores(xs):
+                return jnp.asarray(xs).sum()
+
+            def mean(xs):
+                # quiverlint: sync-ok[epoch boundary readback]
+                return float(_scores(xs))
+        """)
+        assert r.findings == []
+
+    def test_stale_sync_ok_reported(self, tmp_path):
+        r = run_lint(tmp_path, """
+            def mean(xs):
+                # quiverlint: sync-ok[nothing here syncs anymore]
+                return float(sum(xs))
+        """)
+        assert r.findings == []
+        assert [(line, reason) for _, line, reason in r.stale_sync_ok] \
+            == [(3, "nothing here syncs anymore")]
+
+    def test_directive_in_string_is_not_a_waiver(self, tmp_path):
+        # docstrings may *show* the syntax without registering with the
+        # staleness audit (the linter's own rule modules rely on this)
+        r = run_lint(tmp_path, '''
+            def helper():
+                """Waive with `# quiverlint: sync-ok[reason]`."""
+                return 1
+        ''')
+        assert r.findings == []
+        assert r.stale_sync_ok == []
+
+
+# ------------------------------------------------------- QT014 behavior
+class TestExecutableKeys:
+    def test_raw_runtime_key_flagged(self, tmp_path):
+        r = run_lint(tmp_path, """
+            from quiver_tpu.recovery.registry import program_cache
+
+            class G:
+                def __init__(self):
+                    self._fns = program_cache("g", owner=self)
+
+                def run(self, ids):
+                    n = int(ids.shape[0])
+                    if n not in self._fns:
+                        self._fns[n] = object()
+                    return self._fns[n]
+        """)
+        assert codes(r) == ["QT014"]
+
+    def test_bucketed_key_clean(self, tmp_path):
+        r = run_lint(tmp_path, """
+            from quiver_tpu.recovery.registry import program_cache
+
+            def _pow2_bucket(n):
+                b = 1
+                while b < n:
+                    b *= 2
+                return b
+
+            class G:
+                def __init__(self):
+                    self._fns = program_cache("g", owner=self)
+
+                def run(self, ids):
+                    b = _pow2_bucket(int(ids.shape[0]))
+                    if b not in self._fns:
+                        self._fns[b] = object()
+                    return self._fns[b]
+        """)
+        assert r.findings == []
+
+    def test_tuple_key_reports_offending_component(self, tmp_path):
+        r = run_lint(tmp_path, """
+            from quiver_tpu.recovery.registry import program_cache
+
+            class G:
+                def __init__(self):
+                    self._fns = program_cache("g", owner=self)
+                    self.mode = "dense"
+
+                def run(self, ids):
+                    key = (self.mode, int(ids.shape[0]))
+                    if key not in self._fns:
+                        self._fns[key] = object()
+                    return self._fns[key]
+        """)
+        assert codes(r) == ["QT014"]
+        assert "shape" in r.findings[0].message
+
+    def test_bucketed_directive_on_helper(self, tmp_path):
+        r = run_lint(tmp_path, """
+            from quiver_tpu.recovery.registry import program_cache
+
+            # quiverlint: bucketed[result drawn from a fixed table]
+            def snap(n):
+                return n
+
+            class G:
+                def __init__(self):
+                    self._fns = program_cache("g", owner=self)
+
+                def run(self, ids):
+                    b = snap(int(ids.shape[0]))
+                    if b not in self._fns:
+                        self._fns[b] = object()
+                    return self._fns[b]
+        """)
+        assert r.findings == []
+
+    def test_config_bucket_helpers_extend_the_set(self, tmp_path):
+        cfg = LintConfig(bucket_helpers=("my_bucket",))
+        r = run_lint(tmp_path, config=cfg, source="""
+            from quiver_tpu.recovery.registry import program_cache
+
+            def my_bucket(n):
+                return n
+
+            class G:
+                def __init__(self):
+                    self._fns = program_cache("g", owner=self)
+
+                def run(self, ids):
+                    b = my_bucket(int(ids.shape[0]))
+                    if b not in self._fns:
+                        self._fns[b] = object()
+                    return self._fns[b]
+        """)
+        assert r.findings == []
+
+
+# ------------------------------------------------------- QT015 behavior
+class TestCollectiveDiscipline:
+    def test_float_psum_in_bitexact_module_flagged(self, tmp_path):
+        r = run_lint(tmp_path, """
+            import jax
+            from jax.sharding import Mesh
+
+            AXIS = "shard"
+
+            def _combine(x):
+                return jax.lax.psum(x, AXIS)
+
+            def run(x, devices):
+                mesh = Mesh(devices, (AXIS,))
+                with mesh:
+                    return jax.pmap(_combine, axis_name=AXIS)(x)
+        """)
+        assert codes(r) == ["QT015"]
+
+    def test_int_psum_and_pmax_clean(self, tmp_path):
+        r = run_lint(tmp_path, """
+            import jax
+            import jax.numpy as jnp
+            from jax.sharding import Mesh
+
+            AXIS = "shard"
+
+            def _combine(x, mask):
+                payload = jax.lax.pmax(x, AXIS)
+                count = jax.lax.psum(mask.astype(jnp.int32), AXIS)
+                return payload, count
+
+            def run(x, mask, devices):
+                mesh = Mesh(devices, (AXIS,))
+                with mesh:
+                    return jax.pmap(_combine, axis_name=AXIS)(x, mask)
+        """)
+        assert r.findings == []
+
+    def test_undeclared_axis_name_flagged(self, tmp_path):
+        r = run_lint(tmp_path, name="cold.py", source="""
+            import jax
+            from jax.sharding import Mesh
+
+            def _combine(x):
+                return jax.lax.pmax(x, "sahrd")
+
+            def run(x, devices):
+                mesh = Mesh(devices, ("shard",))
+                with mesh:
+                    return jax.pmap(_combine, axis_name="shard")(x)
+        """)
+        assert codes(r) == ["QT015"]
+        assert "sahrd" in r.findings[0].message
+
+    def test_float_psum_outside_bitexact_scope_allowed(self, tmp_path):
+        r = run_lint(tmp_path, name="cold.py", source="""
+            import jax
+            from jax.sharding import Mesh
+
+            def _combine(x):
+                return jax.lax.psum(x, "shard")
+
+            def run(x, devices):
+                mesh = Mesh(devices, ("shard",))
+                with mesh:
+                    return jax.pmap(_combine, axis_name="shard")(x)
+        """)
+        assert r.findings == []
+
+
+# --------------------------------------------------- fixture package e2e
+@pytest.mark.parametrize("pkg, expected", [
+    ("sync_seeded", ["QT013"]),
+    ("sync_clean", []),
+    ("keys_seeded", ["QT014"]),
+    ("keys_clean", []),
+    ("psum_seeded", ["QT015"]),
+    ("psum_clean", []),
+])
+def test_fixture_packages(pkg, expected):
+    r = analyze_paths([str(FIXTURES / pkg)], config=FIXTURE_CFG,
+                      root=FIXTURES)
+    assert r.errors == []
+    assert codes(r) == expected, [f.format() for f in r.findings]
+
+
+# ------------------------------------------------------ baseline hygiene
+class TestRuleHashStamps:
+    def test_fingerprints_cover_every_rule(self):
+        from quiver_tpu.analysis.rules import RULE_CLASSES
+
+        fps = rule_fingerprints()
+        assert set(fps) == {cls.code for cls in RULE_CLASSES}
+        assert all(len(h) == 16 for h in fps.values())
+
+    def test_saved_baseline_stamps_rule_hash(self, tmp_path):
+        r = analyze_paths([str(FIXTURES / "keys_seeded")],
+                          config=FIXTURE_CFG, root=FIXTURES)
+        out = tmp_path / "base.json"
+        baseline_mod.save(out, r.findings)
+        doc = json.loads(out.read_text())
+        assert doc["version"] == 2
+        assert doc["findings"][0]["rule_hash"] \
+            == rule_fingerprints()["QT014"]
+
+    def test_hash_mismatch_detected(self, tmp_path):
+        r = analyze_paths([str(FIXTURES / "keys_seeded")],
+                          config=FIXTURE_CFG, root=FIXTURES)
+        out = tmp_path / "base.json"
+        baseline_mod.save(out, r.findings)
+        doc = json.loads(out.read_text())
+        doc["findings"][0]["rule_hash"] = "0" * 16
+        out.write_text(json.dumps(doc))
+        entries = baseline_mod.load_entries(out)
+        bad = baseline_mod.hash_mismatches(entries, rule_fingerprints())
+        assert len(bad) == 1 and bad[0][0].rule == "QT014"
+
+    def test_v1_entries_without_hash_are_exempt(self, tmp_path):
+        r = analyze_paths([str(FIXTURES / "keys_seeded")],
+                          config=FIXTURE_CFG, root=FIXTURES)
+        out = tmp_path / "base.json"
+        baseline_mod.save(out, r.findings)
+        doc = json.loads(out.read_text())
+        doc["version"] = 1
+        for f in doc["findings"]:
+            f.pop("rule_hash", None)
+        out.write_text(json.dumps(doc))
+        entries = baseline_mod.load_entries(out)
+        assert baseline_mod.hash_mismatches(
+            entries, rule_fingerprints()) == []
+
+
+def test_rule_hash_mismatch_fails_cli_only_under_strict(tmp_path):
+    import shutil
+
+    shutil.copytree(REPO / "quiver_tpu", tmp_path / "quiver_tpu")
+    shutil.copy(REPO / "bench.py", tmp_path / "bench.py")
+    doc = json.loads(
+        (REPO / baseline_mod.DEFAULT_BASELINE_NAME).read_text())
+    for f in doc["findings"]:
+        f["rule_hash"] = "f" * 16
+    (tmp_path / baseline_mod.DEFAULT_BASELINE_NAME).write_text(
+        json.dumps(doc))
+    base_cmd = [sys.executable, "-m", "quiver_tpu.analysis",
+                "quiver_tpu", "bench.py"]
+    lax = subprocess.run(base_cmd, capture_output=True, text=True,
+                         timeout=300, cwd=str(tmp_path))
+    assert lax.returncode == 0, lax.stdout + lax.stderr
+    strict = subprocess.run(base_cmd + ["--strict-baseline"],
+                            capture_output=True, text=True, timeout=300,
+                            cwd=str(tmp_path))
+    assert strict.returncode == 1, strict.stdout + strict.stderr
+    assert "rule-hash mismatch" in strict.stdout
+
+
+def test_stale_sync_ok_fails_cli_only_under_strict(tmp_path):
+    import shutil
+
+    shutil.copytree(REPO / "quiver_tpu", tmp_path / "quiver_tpu")
+    shutil.copy(REPO / "bench.py", tmp_path / "bench.py")
+    shutil.copy(REPO / baseline_mod.DEFAULT_BASELINE_NAME,
+                tmp_path / baseline_mod.DEFAULT_BASELINE_NAME)
+    target = tmp_path / "quiver_tpu" / "sampler.py"
+    target.write_text(target.read_text() + textwrap.dedent("""
+
+        def _nothing_syncs_here(xs):
+            # quiverlint: sync-ok[left behind after a refactor]
+            return sum(xs)
+    """))
+    base_cmd = [sys.executable, "-m", "quiver_tpu.analysis",
+                "quiver_tpu", "bench.py"]
+    lax = subprocess.run(base_cmd, capture_output=True, text=True,
+                         timeout=300, cwd=str(tmp_path))
+    assert lax.returncode == 0, lax.stdout + lax.stderr
+    strict = subprocess.run(base_cmd + ["--strict-baseline"],
+                            capture_output=True, text=True, timeout=300,
+                            cwd=str(tmp_path))
+    assert strict.returncode == 1, strict.stdout + strict.stderr
+    assert "stale sync-ok" in strict.stdout

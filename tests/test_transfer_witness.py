@@ -1,0 +1,195 @@
+"""Device-transfer witness tests (quiverlint v3's dynamic half).
+
+The install/uninstall fixture drives the witness directly so these run
+in the normal suite too; under ``make sanitize`` (QUIVER_SANITIZE=1)
+install() is a no-op on the already-installed witness and teardown
+leaves it in place for the rest of the session.
+
+The in-region test is deliberately deterministic: the coercion happens
+on this thread, inside the ``with`` block, every run — no timing or
+device luck involved.  Zero-overhead-off and env-gate contracts run in
+fresh subprocesses so the import-time behavior is the real thing.
+"""
+
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from quiver_tpu.analysis import staging, transfer_witness
+from quiver_tpu.analysis.staging import regions
+
+pytestmark = pytest.mark.sanitize
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+@pytest.fixture
+def tw():
+    was_installed = transfer_witness.installed()
+    transfer_witness.install()
+    transfer_witness.drain()
+    yield transfer_witness
+    transfer_witness.drain()
+    if not was_installed:  # don't tear down the session-wide sanitizer
+        transfer_witness.uninstall()
+
+
+@pytest.fixture
+def live_telemetry():
+    from quiver_tpu import telemetry
+
+    was = telemetry.enabled()
+    telemetry.set_enabled(True)
+    telemetry.reset()
+    yield telemetry
+    telemetry.reset()
+    telemetry.set_enabled(was)
+
+
+def test_transfers_observed_and_attributed(tw):
+    x = jnp.arange(4)
+    _ = float(x.sum())
+    _ = np.asarray(x)
+    sites = [t.site for t in tw.transfers()]
+    assert "float()" in sites and "np.asarray" in sites
+    me = Path(__file__).name
+    assert any(t.where.startswith(me) for t in tw.transfers())
+    assert tw.violations() == []  # outside any region: observed, legal
+
+
+def test_device_get_records_exactly_one_transfer(tw):
+    # device_get materializes via np.asarray internally — the re-entry
+    # guard must collapse that to ONE attributed transfer, not two
+    _ = jax.device_get(jnp.arange(3))
+    assert [t.site for t in tw.transfers()] == ["jax.device_get"]
+
+
+def test_in_region_sync_is_deterministic_violation(tw):
+    with staging.no_sync("unit region"):
+        _ = np.asarray(jnp.arange(3))
+    vs = tw.drain()
+    assert [v.kind for v in vs] == ["in-region-sync"]
+    assert "unit region" in vs[0].message
+    assert "np.asarray" in vs[0].message
+
+
+def test_host_data_in_region_stays_quiet(tw):
+    with staging.no_sync("unit region"):
+        _ = np.asarray([1, 2, 3])  # host data: no transfer at all
+        _ = float(3.5)
+    assert tw.drain() == []
+
+
+def test_install_arms_region_gate(tw):
+    assert regions.on()
+    with staging.no_sync("lbl"):
+        assert staging.active() == "lbl"
+        with staging.no_sync("inner"):
+            assert staging.active() == "inner"
+        assert staging.active() == "lbl"
+    assert staging.active() is None
+
+
+def test_region_gate_is_single_global_read():
+    # the off-path cost of on() is pinned to one module-global load —
+    # the same gating contract the timeline's hot-path guard carries
+    assert regions.on.__code__.co_names == ("_ON",)
+
+
+def test_attribution_lands_on_live_trace(tw, live_telemetry):
+    from quiver_tpu.telemetry import flightrec
+
+    tr = flightrec.new_trace()
+    assert tr is not None
+    with flightrec.activate(tr):
+        _ = np.asarray(jnp.arange(3))
+    evs = [e for e in tr.events if e[1] == "host_transfer"]
+    assert evs, tr.events
+    assert evs[0][3]["site"] == "np.asarray"
+    assert evs[0][3]["where"].startswith(Path(__file__).name)
+
+
+def test_counter_ticks_per_site(tw, live_telemetry):
+    _ = float(jnp.arange(2).sum())
+    snap = live_telemetry.snapshot()
+    keys = [k for k in snap.get("counters", {})
+            if "sanitize_host_transfers_total" in k and "float()" in k]
+    assert keys, snap.get("counters", {}).keys()
+
+
+def test_uninstall_restores_coercion_points():
+    if transfer_witness.installed():
+        pytest.skip("sanitize session: witness stays installed")
+    orig_asarray, orig_array = np.asarray, np.array
+    orig_device_get = jax.device_get
+    transfer_witness.install()
+    try:
+        assert np.asarray is not orig_asarray
+        assert jax.device_get is not orig_device_get
+    finally:
+        transfer_witness.uninstall()
+    assert np.asarray is orig_asarray and np.array is orig_array
+    assert jax.device_get is orig_device_get
+    assert not regions.on()
+    assert transfer_witness.transfers() == []
+
+
+def test_region_gate_off_is_shared_noop():
+    if transfer_witness.installed():
+        pytest.skip("sanitize session: gate armed")
+    assert regions.no_sync("a") is regions.no_sync("b")
+    assert staging.active() is None
+
+
+def test_witness_off_is_zero_overhead():
+    """Without QUIVER_SANITIZE, importing quiver_tpu must neither load
+    the transfer witness nor touch numpy/jax coercion points, and the
+    region gate must stay the shared no-op."""
+    env = {k: v for k, v in os.environ.items() if k != "QUIVER_SANITIZE"}
+    env["JAX_PLATFORMS"] = "cpu"
+    code = (
+        "import sys\n"
+        "import numpy as np\n"
+        "import jax\n"
+        "orig_asarray, orig_array = np.asarray, np.array\n"
+        "orig_device_get = jax.device_get\n"
+        "import quiver_tpu\n"
+        "assert 'quiver_tpu.analysis.transfer_witness' not in sys.modules\n"
+        "assert np.asarray is orig_asarray and np.array is orig_array\n"
+        "assert jax.device_get is orig_device_get\n"
+        "from quiver_tpu.analysis.staging import regions\n"
+        "assert regions.on() is False\n"
+        "assert regions.no_sync('a') is regions.no_sync('b')\n"
+    )
+    proc = subprocess.run([sys.executable, "-c", code],
+                          capture_output=True, text=True, timeout=120,
+                          cwd=str(REPO), env=env)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_env_gate_installs_and_records():
+    env = dict(os.environ, QUIVER_SANITIZE="1", JAX_PLATFORMS="cpu")
+    code = (
+        "import quiver_tpu\n"
+        "import numpy as np\n"
+        "import jax.numpy as jnp\n"
+        "from quiver_tpu.analysis import staging\n"
+        "from quiver_tpu.analysis import transfer_witness as tw\n"
+        "assert tw.installed()\n"
+        "with staging.no_sync('gate region'):\n"
+        "    np.asarray(jnp.arange(3))\n"
+        "vs = tw.drain()\n"
+        "assert [v.kind for v in vs] == ['in-region-sync'], vs\n"
+        "assert 'gate region' in vs[0].message\n"
+    )
+    proc = subprocess.run([sys.executable, "-c", code],
+                          capture_output=True, text=True, timeout=120,
+                          cwd=str(REPO), env=env)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
