@@ -98,7 +98,7 @@ fleet:
 # mid-burst, prove zero lost answers + warm rejoin (docs/FLEET.md)
 fleet-chaos:
 	python -m pytest tests/ -m fleet -q
-	python benchmarks/fleet_chaos.py --smoke
+	python benchmarks/fleet_chaos.py --smoke --scenario all
 
 # mesh-native sharded serving suite: 8-virtual-device CPU rehearsal,
 # sharded gather/sampling bit-identity, shard-group failover, coherent

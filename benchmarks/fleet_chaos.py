@@ -36,6 +36,27 @@ on the returned report, and by ``--check`` from the command line):
     redispatched trace_id on two different replica tracks — and that
     ``/debug/fleet/trace``-style reconstruction finds the story.
 
+Three further autonomy scenarios ride behind ``--scenario`` (the
+default remains the follower-kill story above; ``--scenario all`` runs
+everything):
+
+  * ``leader`` — the LEADER is ``kill -9``ed mid-burst with
+    ``fleet_election=on``: the most caught-up follower must promote
+    itself under a strictly higher fenced epoch, the promoted WAL
+    frontier must cover every append the dead leader acked (zero acked
+    loss), writes must flow through the promoted lane, and an append
+    stamped with the deposed epoch must be refused by the fence;
+  * ``walstream`` — followers with PRIVATE recovery roots (no shared
+    WAL directory, no shared checkpoints) replicate purely over the
+    leader's socket WAL stream, survive a seeded mid-stream cut by
+    resuming from their committed LSN, and converge to staleness ≤
+    ``fleet_max_staleness_lsn``;
+  * ``autoscale`` — a compressed diurnal cycle with a 10× burst: the
+    federation-driven autoscaler, taught two synthetic prior days,
+    must warm-spawn ≥ 1 replica BEFORE the burst peak, hold gold p99
+    under 2× baseline through it, drain back down after, and never
+    flap inside a cooldown window.
+
 The model stage is deliberately tiny (default replica service: a
 versioned graph touch) so the harness runs on CPU in minutes; the
 router, membership, WAL shipping, breakers, and the kill are all the
@@ -75,13 +96,19 @@ _TENANT_MIX = ("gold", "gold", "gold", "silver", "silver", "bronze")
 # steadily so WAL shipping stays live during the run.  Followers join
 # through checkpoint restore + WAL tail.  Both warm a sampler and seal
 # at retrace budget 0 — a cold compile after warmup aborts the child.
+# Under ``fleet_election=on`` a follower that wins an election flips to
+# the ingest loop by itself; every leader (original or promoted)
+# publishes its acked WAL frontier to ``acked-<rid>.json`` so the
+# parent can prove zero acked loss across a kill -9.  A
+# ``drain-<rid>`` trigger file makes the child drain and exit — the
+# autoscaler's scale-down choreography.
 _REPLICA_CHILD = r"""
 import glob, json, os, sys, time
 import numpy as np
 import quiver_tpu.config as config_mod
 
 (root, fleet_dir, cache_dir, rid, role, ingest_rps, serve_every,
- chaos_seed) = sys.argv[1:9]
+ chaos_seed, walstream_fault_after) = sys.argv[1:10]
 # budget 4, not 0: the stream sampler legitimately builds one program
 # per delta-overlay BUCKET it serves (geometric growth schedule), and
 # live ingest crosses a few buckets after warmup.  The seal still
@@ -103,13 +130,24 @@ N = 64
 # every process records its own timeline; the parent's federation
 # pulls /debug/timeline from each and merges them onto one wall clock
 timeline.enable()
+plan = chaos.ChaosPlan(seed=int(chaos_seed))
+armed = False
 if int(serve_every) > 0:
     # deterministic serve faults on THIS follower: accepted requests
     # answer `unavailable` after trace rehydration, so the router
     # redispatches and the same trace_id lands on a second replica's
     # timeline — the cross-process story the merged trace must show
-    chaos.install(chaos.ChaosPlan(seed=int(chaos_seed)).fail(
-        "fleet.serve", times=None, after=1, every=int(serve_every)))
+    plan.fail("fleet.serve", times=None, after=1, every=int(serve_every))
+    armed = True
+if int(walstream_fault_after) > 0:
+    # one mid-stream cut on the leader's walstream endpoint: the Nth
+    # shipped frame dies in flight, the socket drops, and the follower
+    # must resume from its committed LSN on reconnect
+    plan.fail("fleet.walstream.send", after=int(walstream_fault_after),
+              times=1)
+    armed = True
+if armed:
+    chaos.install(plan)
 
 def factory():
     src = np.arange(N, dtype=np.int64)
@@ -165,31 +203,54 @@ print(json.dumps({
     "sampler_builds": reg.stats().get("sampler", {}).get("builds", 0),
 }), flush=True)
 
-if role == "leader":
-    period = 1.0 / max(float(ingest_rps), 1.0)
-    i = 64
-    while True:
+ack_path = os.path.join(fleet_dir, "acked-" + rid + ".json")
+drain_path = os.path.join(fleet_dir, "drain-" + rid)
+
+def write_ack(i):
+    # atomic so the parent never reads a torn frontier; this file is
+    # the "what did the dead leader ack" evidence after a kill -9
+    tmp = ack_path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(json.dumps({"i": i,
+                            "wal_next_lsn": int(rep.manager.wal.next_lsn)}))
+    os.replace(tmp, ack_path)
+
+period = 1.0 / max(float(ingest_rps), 1.0)
+i = 64
+while True:
+    if os.path.exists(drain_path):
+        rep.drain()
+        rep.stop()
+        sys.exit(0)
+    # a follower that won an election flips to the ingest loop: the
+    # promoted lane is the proof that writes flow post-failover
+    if rep.role == "leader" and rep.lane is not None:
         rep.lane.submit([i % N], [(i * 7 + 3) % N])
         _u, res = rep.lane.results.get(timeout=30)
+        if isinstance(res, Exception):
+            raise res
+        write_ack(i)
         i += 1
         time.sleep(period)
-else:
-    while True:
-        time.sleep(0.5)
+    else:
+        time.sleep(0.05)
 """
 
 
 def _spawn(root, fleet_dir, cache_dir, rid, role, ingest_rps=100.0,
-           serve_fault_every=0, chaos_seed=0):
+           serve_fault_every=0, chaos_seed=0, walstream_fault_after=0,
+           extra_env=None):
     env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO,
                PYTHONUNBUFFERED="1",
                QUIVER_TPU_FLEET_SHIP_POLL_MS="10",
                QUIVER_TPU_FLEET_SHIP_GRACE_MS="60",
                QUIVER_TPU_FLEET_HEARTBEAT_S="0.2")
+    env.update(extra_env or {})
     return subprocess.Popen(
         [sys.executable, "-c", _REPLICA_CHILD, root, fleet_dir,
          cache_dir, rid, role, str(ingest_rps),
-         str(int(serve_fault_every)), str(int(chaos_seed))],
+         str(int(serve_fault_every)), str(int(chaos_seed)),
+         str(int(walstream_fault_after))],
         cwd=REPO, env=env, stdout=subprocess.PIPE,
         stderr=subprocess.PIPE, text=True)
 
@@ -500,6 +561,604 @@ def check(report: dict) -> list:
     return fails
 
 
+# -------------------------------------------------- fleet autonomy
+# election clocks for the leader-kill scenario: detection in ~1.2s,
+# candidates stagger 0.4s per rank, fence re-checks on every append
+_ELECTION_ENV = {
+    "QUIVER_TPU_FLEET_ELECTION": "on",
+    "QUIVER_TPU_FLEET_ELECTION_POLL_S": "0.1",
+    "QUIVER_TPU_FLEET_ELECTION_STAGGER_S": "0.4",
+    "QUIVER_TPU_FLEET_ELECTION_FENCE_RECHECK_S": "0",
+    "QUIVER_TPU_FLEET_HEARTBEAT_TIMEOUT_S": "1.2",
+}
+
+
+def _scrape_counter_sum(directory, rid: str, name: str) -> float:
+    """Sum one counter family straight off a replica's ``/metrics``."""
+    import urllib.request
+
+    from quiver_tpu.fleet import parse_prometheus_text
+
+    info = directory.get(rid)
+    if info is None:
+        return 0.0
+    port = int((info.detail or {}).get("metrics_port", 0) or 0)
+    if not port:
+        return 0.0
+    with urllib.request.urlopen(
+            f"http://{info.host}:{port}/metrics", timeout=5) as r:
+        text = r.read().decode()
+    scrape, _errs = parse_prometheus_text(text)
+    return sum(v for (n, _l), v in scrape["counters"].items()
+               if n == name)
+
+
+def _drive_phases(router, rng, report, n_req, kill=None):
+    """The shared request driver: zipfian traffic per phase, optional
+    mid-burst kill callback, loss accounting identical to the failover
+    scenario's contract."""
+    from quiver_tpu.resilience.errors import NoReplicaAvailable
+
+    for phase, count in n_req.items():
+        kill_at = count // 3 if (kill and phase == "burst") else None
+        lat, counts = [], {"offered": 0, "ok": 0, "shed": 0,
+                           "error": 0, "unroutable": 0, "unanswered": 0}
+        for i in range(count):
+            if kill_at is not None and i == kill_at:
+                kill()
+            ids = [int(rng.zipf(1.7)) % N_NODES,
+                   int(rng.integers(N_NODES))]
+            tenant = _TENANT_MIX[int(rng.integers(len(_TENANT_MIX)))]
+            counts["offered"] += 1
+            t0 = time.perf_counter()
+            try:
+                reply = router.request(ids, tenant=tenant, seq=i)
+                status = reply.get("status", "error")
+                counts["ok" if status == "ok" else
+                       "shed" if status == "shed" else "error"] += 1
+            except NoReplicaAvailable:
+                counts["unroutable"] += 1
+            except Exception:
+                counts["unanswered"] += 1
+            lat.append((time.perf_counter() - t0) * 1e3)
+        counts["p50_ms"] = round(_percentile(lat, 50), 3)
+        counts["p99_ms"] = round(_percentile(lat, 99), 3)
+        report["phases"][phase] = counts
+    report["lost_answers"] = sum(
+        p["unanswered"] for p in report["phases"].values())
+    base_p99 = report["phases"].get("baseline", {}).get("p99_ms") or 1e-9
+    if "burst" in report["phases"]:
+        report["failover"]["p99_ratio_burst_vs_baseline"] = round(
+            report["phases"]["burst"]["p99_ms"] / base_p99, 3)
+
+
+def run_leader_failover(smoke: bool = False, seed: int = 0,
+                        workdir: str | None = None) -> dict:
+    """Leader kill -9 mid-burst → fenced promotion of the most
+    caught-up follower: strictly higher epoch, zero acked WAL loss,
+    writes flowing through the promoted lane, and the deposed epoch's
+    append refused by the fence."""
+    from quiver_tpu.fleet import FleetRouter, MembershipDirectory
+    from quiver_tpu.fleet.election import (ElectionDirectory, EpochFence,
+                                           FencedWAL, StaleEpochError)
+    from quiver_tpu.resilience.qos import (QoSController, install_qos,
+                                           parse_tenant_spec)
+
+    rng = np.random.default_rng(seed)
+    tmp = workdir or tempfile.mkdtemp(prefix="fleet_leaderkill_")
+    root = os.path.join(tmp, "dur")
+    fleet_dir = os.path.join(tmp, "fleet")
+    cache_dir = os.path.join(tmp, "pcache")
+    os.makedirs(cache_dir, exist_ok=True)
+    n_req = {"baseline": 150, "burst": 300, "cool": 150} if smoke else \
+            {"baseline": 400, "burst": 800, "cool": 400}
+    install_qos(QoSController(classes=parse_tenant_spec(TENANTS),
+                              default="bronze", ingest="bronze"))
+    directory = MembershipDirectory(fleet_dir, heartbeat_timeout_s=2.0)
+    procs: dict = {}
+    report: dict = {"seed": seed, "smoke": smoke,
+                    "scenario": "leader_failover",
+                    "phases": {}, "failover": {}}
+    t_start = time.perf_counter()
+    router = None
+    try:
+        procs["r0"] = _spawn(root, fleet_dir, cache_dir, "r0", "leader",
+                             ingest_rps=150.0, extra_env=_ELECTION_ENV)
+        boots = [_wait_ready(procs["r0"])]
+        for rid in ("r1", "r2"):
+            procs[rid] = _spawn(root, fleet_dir, cache_dir, rid,
+                                "follower", extra_env=_ELECTION_ENV)
+        boots += [_wait_ready(procs["r1"]), _wait_ready(procs["r2"])]
+        for rid in ("r0", "r1", "r2"):
+            _wait_serving(directory, rid)
+        report["cold_boots"] = boots
+        old = directory.leader()
+        report["failover"]["old_leader"] = old.replica_id
+        report["failover"]["old_epoch"] = old.epoch
+
+        router = FleetRouter(directory, partitions=64, scan_ttl_s=0.05,
+                             request_timeout_s=2.0)
+        t_kill = [None]
+
+        def kill_leader():
+            proc = procs["r0"]
+            os.kill(proc.pid, signal.SIGKILL)
+            proc.wait(timeout=30)
+            t_kill[0] = time.perf_counter()
+            report["failover"]["kill_returncode"] = proc.returncode
+            report["failover"]["killed"] = "r0"
+
+        _drive_phases(router, rng, report,
+                      {"baseline": n_req["baseline"],
+                       "burst": n_req["burst"]}, kill=kill_leader)
+
+        # fenced promotion: a follower must take over with a strictly
+        # higher epoch (the burst usually contains it; wait out stragglers)
+        promoted = None
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            info = directory.leader()
+            if (info is not None and info.replica_id != "r0"
+                    and info.epoch > report["failover"]["old_epoch"]):
+                promoted = info
+                break
+            time.sleep(0.05)
+        if promoted is None:
+            raise TimeoutError("no follower promoted after leader kill")
+        report["failover"]["promoted"] = promoted.replica_id
+        report["failover"]["new_epoch"] = promoted.epoch
+        report["failover"]["failover_seconds"] = round(
+            time.perf_counter() - t_kill[0], 3)
+
+        # zero acked loss + writes flow: the successor's WAL frontier
+        # must cover everything the dead leader acked, then keep moving
+        with open(os.path.join(fleet_dir, "acked-r0.json")) as f:
+            acked = json.load(f)
+        target = acked["wal_next_lsn"]
+        deadline = time.time() + 60
+        frontier = -1
+        while time.time() < deadline:
+            info = directory.get(promoted.replica_id)
+            if info is not None:
+                frontier = info.wal_next_lsn
+                if frontier >= target + 5:
+                    break
+            time.sleep(0.05)
+        report["failover"]["acked_wal_next_lsn"] = target
+        report["failover"]["promoted_wal_next_lsn"] = frontier
+        report["failover"]["zero_acked_loss"] = frontier >= target
+        report["failover"]["writes_flow"] = frontier >= target + 5
+
+        # the deposed epoch is fenced: an append stamped with the dead
+        # leader's epoch refuses before it can touch the log
+        class _NeverWAL:
+            def append(self, payload):
+                raise AssertionError("fence let a deposed append through")
+
+        fence = EpochFence(ElectionDirectory(fleet_dir),
+                           report["failover"]["old_epoch"], "r0",
+                           recheck_s=0.0)
+        try:
+            FencedWAL(_NeverWAL(), fence).append(b"deposed-write")
+            report["failover"]["stale_epoch_append_refused"] = False
+        except StaleEpochError:
+            report["failover"]["stale_epoch_append_refused"] = True
+
+        _drive_phases(router, rng, report, {"cool": n_req["cool"]})
+        report["lost_answers"] = sum(
+            p["unanswered"] for p in report["phases"].values())
+        report["elapsed_seconds"] = round(
+            time.perf_counter() - t_start, 1)
+    finally:
+        if router is not None:
+            router.close()
+        for proc in procs.values():
+            _reap(proc)
+        for proc in procs.values():
+            if proc.stdout:
+                proc.stdout.close()
+            if proc.stderr:
+                proc.stderr.close()
+    return report
+
+
+def check_leader_failover(report: dict) -> list:
+    fails = []
+    f = report.get("failover", {})
+    if report.get("lost_answers", 1) != 0:
+        fails.append(f"lost answers: {report.get('lost_answers')}")
+    if f.get("kill_returncode") != -signal.SIGKILL:
+        fails.append(f"leader not SIGKILLed ({f.get('kill_returncode')})")
+    if not f.get("promoted") or f.get("promoted") == f.get("old_leader"):
+        fails.append(f"no distinct follower promoted ({f.get('promoted')})")
+    if not f.get("new_epoch", -1) > f.get("old_epoch", -1):
+        fails.append(f"promotion epoch not strictly higher "
+                     f"({f.get('old_epoch')} -> {f.get('new_epoch')})")
+    if not f.get("zero_acked_loss", False):
+        fails.append(f"acked WAL records lost: frontier "
+                     f"{f.get('promoted_wal_next_lsn')} < acked "
+                     f"{f.get('acked_wal_next_lsn')}")
+    if not f.get("writes_flow", False):
+        fails.append("writes do not flow through the promoted leader")
+    if not f.get("stale_epoch_append_refused", False):
+        fails.append("deposed stale-epoch append was NOT refused")
+    ratio = f.get("p99_ratio_burst_vs_baseline", 99.0)
+    if ratio >= 2.0:
+        fails.append(f"failover p99 ratio {ratio} >= 2.0")
+    return fails
+
+
+def run_walstream_chaos(smoke: bool = False, seed: int = 0,
+                        workdir: str | None = None) -> dict:
+    """Socket-shipped followers with NO shared WAL directory: each
+    follower owns a private recovery root and tails the leader purely
+    over TCP, survives a seeded mid-stream cut by resuming from its
+    committed LSN, and converges to staleness ≤ the configured bound."""
+    from quiver_tpu.config import get_config
+    from quiver_tpu.fleet import FleetRouter, MembershipDirectory
+    from quiver_tpu.resilience.qos import (QoSController, install_qos,
+                                           parse_tenant_spec)
+
+    rng = np.random.default_rng(seed)
+    tmp = workdir or tempfile.mkdtemp(prefix="fleet_walstream_")
+    fleet_dir = os.path.join(tmp, "fleet")
+    cache_dir = os.path.join(tmp, "pcache")
+    os.makedirs(cache_dir, exist_ok=True)
+    env = {"QUIVER_TPU_FLEET_WALSTREAM": "on"}
+    n_req = 200 if smoke else 600
+    install_qos(QoSController(classes=parse_tenant_spec(TENANTS),
+                              default="bronze", ingest="bronze"))
+    directory = MembershipDirectory(fleet_dir, heartbeat_timeout_s=2.0)
+    procs: dict = {}
+    report: dict = {"seed": seed, "smoke": smoke,
+                    "scenario": "walstream", "phases": {},
+                    "failover": {}, "stream": {}, "followers": {}}
+    t_start = time.perf_counter()
+    router = None
+    try:
+        # the 41st shipped frame dies mid-send: one follower's catch-up
+        # is cut and must resume (the leader seeds 64 records, so the
+        # cut lands inside the initial stream)
+        procs["r0"] = _spawn(os.path.join(tmp, "dur-r0"), fleet_dir,
+                             cache_dir, "r0", "leader",
+                             ingest_rps=150.0, chaos_seed=seed,
+                             walstream_fault_after=40, extra_env=env)
+        boots = [_wait_ready(procs["r0"])]
+        for rid in ("r1", "r2"):
+            # PRIVATE WAL roots: the follower's wal/ is its own (and
+            # stays empty — the socket is the only log channel), while
+            # ckpt/ links to the shared checkpoint store (the object-
+            # store analog) so restore + gap resync have a floor to
+            # stream from once the leader truncates behind a checkpoint
+            private = os.path.join(tmp, f"dur-{rid}")
+            os.makedirs(private, exist_ok=True)
+            os.symlink(os.path.join(tmp, "dur-r0", "ckpt"),
+                       os.path.join(private, "ckpt"))
+            procs[rid] = _spawn(private, fleet_dir, cache_dir, rid,
+                                "follower", extra_env=env)
+        boots += [_wait_ready(procs["r1"], timeout=600),
+                  _wait_ready(procs["r2"], timeout=600)]
+        for rid in ("r0", "r1", "r2"):
+            _wait_serving(directory, rid)
+        report["cold_boots"] = boots
+
+        router = FleetRouter(directory, partitions=64, scan_ttl_s=0.05,
+                             request_timeout_s=2.0)
+        _drive_phases(router, rng, report, {"baseline": n_req})
+
+        # followers must converge under the staleness bound while the
+        # leader keeps appending at 150 rps
+        bound = get_config().fleet_max_staleness_lsn
+        deadline = time.time() + 60
+        stale = {}
+        while time.time() < deadline:
+            stale = {rid: directory.get(rid).staleness_lsn
+                     for rid in ("r1", "r2")
+                     if directory.get(rid) is not None}
+            if len(stale) == 2 and all(v <= bound
+                                       for v in stale.values()):
+                break
+            time.sleep(0.1)
+        for rid, v in stale.items():
+            report["followers"][rid] = {
+                "staleness_lsn": v, "within_bound": v <= bound}
+        report["stream"]["staleness_bound"] = bound
+        report["stream"]["leader_resumes"] = _scrape_counter_sum(
+            directory, "r0", "fleet_walstream_resumes_total")
+        report["stream"]["leader_sent"] = _scrape_counter_sum(
+            directory, "r0", "fleet_walstream_sent_total")
+        report["stream"]["follower_reconnects"] = sum(
+            _scrape_counter_sum(directory, rid,
+                                "fleet_walstream_reconnects_total")
+            for rid in ("r1", "r2"))
+        report["stream"]["crc_errors"] = sum(
+            _scrape_counter_sum(directory, rid,
+                                "fleet_walstream_crc_errors_total")
+            for rid in ("r1", "r2"))
+        report["elapsed_seconds"] = round(
+            time.perf_counter() - t_start, 1)
+    finally:
+        if router is not None:
+            router.close()
+        for proc in procs.values():
+            _reap(proc)
+        for proc in procs.values():
+            if proc.stdout:
+                proc.stdout.close()
+            if proc.stderr:
+                proc.stderr.close()
+    return report
+
+
+def check_walstream(report: dict) -> list:
+    fails = []
+    if report.get("lost_answers", 1) != 0:
+        fails.append(f"lost answers: {report.get('lost_answers')}")
+    followers = report.get("followers", {})
+    if len(followers) < 2:
+        fails.append(f"expected 2 socket followers, saw "
+                     f"{sorted(followers)}")
+    for rid, f in followers.items():
+        if not f.get("within_bound", False):
+            fails.append(f"follower {rid} staleness "
+                         f"{f.get('staleness_lsn')} over bound "
+                         f"{report['stream'].get('staleness_bound')}")
+    s = report.get("stream", {})
+    if not s.get("leader_resumes", 0) >= 1:
+        fails.append("mid-stream cut never forced a resume-from-LSN")
+    if not s.get("follower_reconnects", 0) >= 1:
+        fails.append("no follower reconnected after the stream cut")
+    if s.get("crc_errors", 0) != 0:
+        fails.append(f"receiver-side CRC errors: {s.get('crc_errors')}")
+    return fails
+
+
+def run_diurnal_autoscale(smoke: bool = False, seed: int = 0,
+                          workdir: str | None = None) -> dict:
+    """A compressed diurnal cycle with a 10× burst: the predictor is
+    taught two synthetic prior days, then one live day runs — the
+    profile must trigger a predictive warm spawn BEFORE the burst
+    window, the joined replica serves through the peak, and the scaler
+    drains back down after, never flapping inside a cooldown window."""
+    from quiver_tpu.fleet import FleetRouter, MembershipDirectory
+    from quiver_tpu.fleet.autoscaler import (DiurnalPredictor,
+                                             FleetAutoscaler)
+    from quiver_tpu.resilience.errors import NoReplicaAvailable
+    from quiver_tpu.resilience.qos import (QoSController, install_qos,
+                                           parse_tenant_spec)
+
+    rng = np.random.default_rng(seed)
+    tmp = workdir or tempfile.mkdtemp(prefix="fleet_autoscale_")
+    root = os.path.join(tmp, "dur")
+    fleet_dir = os.path.join(tmp, "fleet")
+    cache_dir = os.path.join(tmp, "pcache")
+    os.makedirs(cache_dir, exist_ok=True)
+    period = 45.0 if smoke else 90.0
+    burst_lo, burst_hi = 0.5, 0.8          # burst window (phase)
+    low_rps, burst_rps = 10.0, 100.0       # the 10x diurnal swing
+    rps_per_replica = 30.0
+    cooldown = period / 6
+    horizon = period * 0.3                 # looks into the burst early
+    install_qos(QoSController(classes=parse_tenant_spec(TENANTS),
+                              default="bronze", ingest="bronze"))
+    directory = MembershipDirectory(fleet_dir, heartbeat_timeout_s=2.0)
+    procs: dict = {}
+    report: dict = {"seed": seed, "smoke": smoke,
+                    "scenario": "autoscale", "phases": {},
+                    "failover": {}, "autoscale": {}}
+    t_start = time.perf_counter()
+    router = None
+    try:
+        procs["r0"] = _spawn(root, fleet_dir, cache_dir, "r0", "leader",
+                             ingest_rps=50.0)
+        boots = [_wait_ready(procs["r0"])]
+        procs["f1"] = _spawn(root, fleet_dir, cache_dir, "f1",
+                             "follower")
+        boots.append(_wait_ready(procs["f1"]))
+        for rid in ("r0", "f1"):
+            _wait_serving(directory, rid)
+        report["cold_boots"] = boots
+
+        router = FleetRouter(directory, partitions=64, scan_ttl_s=0.05,
+                             request_timeout_s=2.0, federation=True)
+        fed = router.federation
+
+        # teach two synthetic prior days so the live day's ramp is a
+        # RECURRING pattern the profile anticipates, not a surprise
+        buckets = 18
+        t0 = time.time() + 1.0
+        predictor = DiurnalPredictor(period_s=period, buckets=buckets,
+                                     alpha=0.7, window=64)
+        for day in (2, 1):
+            for b in range(buckets):
+                phase = (b + 0.5) / buckets
+                ts = t0 - day * period + phase * period
+                predictor.observe(
+                    ts, burst_rps if burst_lo <= phase < burst_hi
+                    else low_rps)
+
+        next_id = [2]
+        joins, drains, decisions = [], [], []
+
+        def spawn_fn(count):
+            for _ in range(count):
+                rid = f"f{next_id[0]}"
+                next_id[0] += 1
+                procs[rid] = _spawn(root, fleet_dir, cache_dir, rid,
+                                    "follower")
+                joins.append({"replica": rid, "spawn_phase": round(
+                    (time.time() - t0) / period, 3)})
+
+        def drain_fn(victim):
+            if victim:
+                open(os.path.join(fleet_dir, f"drain-{victim}"),
+                     "w").close()
+                drains.append({"replica": victim, "phase": round(
+                    (time.time() - t0) / period, 3)})
+
+        def snapshot_fn():
+            fed.scrape_once()
+            return fed.fleet_snapshot()
+
+        scaler = FleetAutoscaler(
+            snapshot_fn, spawn_fn, drain_fn, directory=directory,
+            predictor=predictor, min_replicas=2, max_replicas=4,
+            cooldown_s=cooldown, rps_per_replica=rps_per_replica,
+            horizon_s=horizon, up_ratio=0.8, down_ratio=0.5)
+        scaler.evaluate_once()  # prime the rate estimator
+
+        # ---- the live day: paced traffic + the control loop --------
+        lat = {"baseline": [], "burst": [], "after": []}
+        counts = {"offered": 0, "ok": 0, "shed": 0, "error": 0,
+                  "unroutable": 0, "unanswered": 0}
+        serving_phase: dict = {}
+        while time.time() < t0:
+            time.sleep(0.01)
+        next_eval = t0
+        next_req = t0
+        i = 0
+        while True:
+            now = time.time()
+            phase = (now - t0) / period
+            if phase >= 1.0:
+                break
+            in_burst = burst_lo <= phase < burst_hi
+            window = ("burst" if in_burst else
+                      "baseline" if phase < burst_lo else "after")
+            if now >= next_eval:
+                d = scaler.evaluate_once()
+                decisions.append({"phase": round(phase, 3),
+                                  "action": d["action"],
+                                  "target": d["target"],
+                                  "current": d["current"],
+                                  "predicted_rps":
+                                      round(d["predicted_rps"], 1),
+                                  "reason": d["reason"]})
+                for j in joins:
+                    rid = j["replica"]
+                    if rid not in serving_phase:
+                        info = directory.get(rid)
+                        if info is not None and info.state == "serving":
+                            serving_phase[rid] = round(phase, 3)
+                            j["serving_phase"] = serving_phase[rid]
+                next_eval = now + 0.5
+            ids = [int(rng.zipf(1.7)) % N_NODES,
+                   int(rng.integers(N_NODES))]
+            tenant = _TENANT_MIX[int(rng.integers(len(_TENANT_MIX)))]
+            counts["offered"] += 1
+            t_req = time.perf_counter()
+            try:
+                reply = router.request(ids, tenant=tenant, seq=i)
+                status = reply.get("status", "error")
+                counts["ok" if status == "ok" else
+                       "shed" if status == "shed" else "error"] += 1
+            except NoReplicaAvailable:
+                counts["unroutable"] += 1
+            except Exception:
+                counts["unanswered"] += 1
+            if tenant == "gold":
+                lat[window].append((time.perf_counter() - t_req) * 1e3)
+            i += 1
+            rate = burst_rps if in_burst else low_rps
+            next_req += 1.0 / rate
+            sleep_s = next_req - time.time()
+            if sleep_s > 0:
+                time.sleep(sleep_s)
+            else:
+                next_req = time.time()  # saturated: don't death-spiral
+
+        # epilogue: idle ticks until the post-burst drain lands (the
+        # day may end inside the cooldown that follows the last spawn)
+        deadline = time.time() + 2 * cooldown + 5
+        while not drains and time.time() < deadline:
+            d = scaler.evaluate_once()
+            decisions.append({"phase": round(
+                (time.time() - t0) / period, 3), "action": d["action"],
+                "target": d["target"], "current": d["current"],
+                "predicted_rps": round(d["predicted_rps"], 1),
+                "reason": d["reason"]})
+            time.sleep(0.5)
+
+        for name in ("baseline", "burst", "after"):
+            counts[f"gold_p99_{name}_ms"] = round(
+                _percentile(lat[name], 99), 3)
+        report["phases"]["live_day"] = counts
+        report["lost_answers"] = counts["unanswered"]
+
+        # late joiners already printed their ready line; collect it now
+        for j in joins:
+            proc = procs.get(j["replica"])
+            if proc is not None and proc.poll() is None:
+                try:
+                    j.update(_wait_ready(proc, timeout=60))
+                except Exception as e:
+                    j["ready_error"] = str(e)
+
+        peak_phase = (burst_lo + burst_hi) / 2
+        warm_before_peak = [
+            j for j in joins
+            if j.get("pcache_hits", 0) > 0
+            and j.get("serving_phase", 9.9) < peak_phase]
+        acts = [d for d in decisions if d["action"] != "hold"]
+        gaps = [round((b["phase"] - a["phase"]) * period, 2)
+                for a, b in zip(acts, acts[1:])]
+        base_p99 = counts["gold_p99_baseline_ms"] or 1e-9
+        report["autoscale"] = {
+            "period_s": period, "cooldown_s": cooldown,
+            "burst_window_phase": [burst_lo, burst_hi],
+            "joins": joins, "drains": drains,
+            "decisions": decisions,
+            "warm_joins_before_peak": len(warm_before_peak),
+            "scale_down_after_burst": bool(drains),
+            "min_action_gap_s": min(gaps) if gaps else None,
+            "gold_p99_ratio_burst_vs_baseline": round(
+                counts["gold_p99_burst_ms"] / base_p99, 3),
+        }
+        report["elapsed_seconds"] = round(
+            time.perf_counter() - t_start, 1)
+    finally:
+        if router is not None:
+            router.close()
+        for proc in procs.values():
+            _reap(proc)
+        for proc in procs.values():
+            if proc.stdout:
+                proc.stdout.close()
+            if proc.stderr:
+                proc.stderr.close()
+    return report
+
+
+def check_autoscale(report: dict) -> list:
+    fails = []
+    a = report.get("autoscale", {})
+    if report.get("lost_answers", 1) != 0:
+        fails.append(f"lost answers: {report.get('lost_answers')}")
+    if not a.get("warm_joins_before_peak", 0) >= 1:
+        fails.append("no warm join landed before the burst peak "
+                     f"(joins: {a.get('joins')})")
+    if not a.get("scale_down_after_burst", False):
+        fails.append("no scale-down after the burst passed")
+    gap = a.get("min_action_gap_s")
+    # 0.6s slack: decisions are sampled on a 0.5s cadence, so two
+    # actions one cooldown apart can stamp up to one tick closer
+    if gap is not None and gap < a.get("cooldown_s", 0) - 0.6:
+        fails.append(f"membership flapped: actions {gap}s apart, "
+                     f"cooldown {a.get('cooldown_s')}s")
+    ratio = a.get("gold_p99_ratio_burst_vs_baseline", 99.0)
+    if ratio >= 2.0:
+        fails.append(f"gold p99 ratio {ratio} >= 2.0")
+    return fails
+
+
+_SCENARIOS = {
+    "failover": (run_fleet_chaos, check),
+    "leader": (run_leader_failover, check_leader_failover),
+    "walstream": (run_walstream_chaos, check_walstream),
+    "autoscale": (run_diurnal_autoscale, check_autoscale),
+}
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--smoke", action="store_true",
@@ -511,43 +1170,89 @@ def main():
                     help="exit 1 unless every acceptance criterion "
                          "holds (p99 ratio included — use on a quiet "
                          "machine)")
+    ap.add_argument("--scenario", default="failover",
+                    choices=sorted(_SCENARIOS) + ["all"],
+                    help="which chaos story to run: follower kill "
+                         "(failover), leader kill + fenced promotion "
+                         "(leader), socket WAL shipping (walstream), "
+                         "diurnal predictive scaling (autoscale), or "
+                         "all of them in sequence")
     args = ap.parse_args()
-    report = run_fleet_chaos(smoke=args.smoke, seed=args.seed)
-    if args.json:
-        print(json.dumps(report, indent=2, sort_keys=True))
-    else:
-        for name, p in report["phases"].items():
-            print(f"{name:9s} offered={p['offered']:5d} ok={p['ok']:5d} "
-                  f"shed={p['shed']:4d} unroutable={p['unroutable']:3d} "
-                  f"unanswered={p['unanswered']:3d} "
-                  f"p50={p['p50_ms']:.2f}ms p99={p['p99_ms']:.2f}ms")
-        f = report["failover"]
-        r = report["rejoin"]
+    names = sorted(_SCENARIOS) if args.scenario == "all" \
+        else [args.scenario]
+    rc = 0
+    for name in names:
+        run_fn, check_fn = _SCENARIOS[name]
+        report = run_fn(smoke=args.smoke, seed=args.seed)
+        if args.json:
+            print(json.dumps(report, indent=2, sort_keys=True))
+        else:
+            _print_report(name, report)
+        # loss/promotion/staleness criteria are backend-independent;
+        # p99 ratios are only meaningful on a quiet machine, so they
+        # gate under --check
+        fails = check_fn(report)
+        gated = fails if args.check else \
+            [x for x in fails if "p99" not in x]
+        for msg in gated:
+            print(f"FAIL[{name}]: {msg}", file=sys.stderr)
+        rc = rc or (1 if gated else 0)
+    return rc
+
+
+def _print_report(scenario: str, report: dict) -> None:
+    print(f"=== scenario: {scenario} ===")
+    for name, p in report.get("phases", {}).items():
+        line = (f"{name:9s} offered={p['offered']:5d} ok={p['ok']:5d} "
+                f"shed={p['shed']:4d} unroutable={p['unroutable']:3d} "
+                f"unanswered={p['unanswered']:3d}")
+        if "p99_ms" in p:
+            line += f" p50={p['p50_ms']:.2f}ms p99={p['p99_ms']:.2f}ms"
+        print(line)
+    f = report.get("failover", {})
+    if f.get("killed"):
         print(f"failover  killed={f.get('killed')} "
               f"rc={f.get('kill_returncode')} "
               f"redispatches={f.get('redispatches')} "
               f"p99x={f.get('p99_ratio_burst_vs_baseline')}")
+    if "promoted" in f:
+        print(f"promotion {f.get('old_leader')} (epoch "
+              f"{f.get('old_epoch')}) -> {f.get('promoted')} (epoch "
+              f"{f.get('new_epoch')}) in {f.get('failover_seconds')}s "
+              f"frontier={f.get('promoted_wal_next_lsn')} acked="
+              f"{f.get('acked_wal_next_lsn')} fenced="
+              f"{f.get('stale_epoch_append_refused')}")
+    r = report.get("rejoin", {})
+    if r:
         print(f"rejoin    {r.get('rejoin_seconds')}s "
               f"pcache_hits={r.get('pcache_hits')} "
               f"new_cache_files={r.get('new_cache_files')} "
               f"staleness={r.get('staleness_lsn_final')} "
               f"(bound {r.get('staleness_bound')}) "
-              f"backend={report['backend']}")
-        o = report.get("observability", {})
+              f"backend={report.get('backend')}")
+    s = report.get("stream", {})
+    if s:
+        print(f"stream    sent={s.get('leader_sent')} "
+              f"resumes={s.get('leader_resumes')} "
+              f"reconnects={s.get('follower_reconnects')} "
+              f"crc_errors={s.get('crc_errors')} followers="
+              f"{report.get('followers')}")
+    a = report.get("autoscale", {})
+    if a:
+        print(f"autoscale joins={a.get('joins')} "
+              f"drains={a.get('drains')} warm_before_peak="
+              f"{a.get('warm_joins_before_peak')} min_gap="
+              f"{a.get('min_action_gap_s')}s gold_p99x="
+              f"{a.get('gold_p99_ratio_burst_vs_baseline')}")
+    o = report.get("observability", {})
+    if o:
         print(f"trace     events={o.get('trace_events')} "
               f"processes={o.get('trace_processes')} "
               f"redispatched={o.get('redispatched_trace_id')} "
               f"on_tracks={o.get('trace_replica_tracks')} "
               f"reconstructed={o.get('reconstruction_found')}")
-        print(f"lost_answers={report['lost_answers']} "
-              f"elapsed={report['elapsed_seconds']}s")
-    # loss/rejoin criteria are backend-independent; the p99 ratio is
-    # only meaningful on a quiet machine, so it gates under --check
-    hard_fails = [x for x in check(report) if "p99" not in x]
-    gated = check(report) if args.check else hard_fails
-    for msg in gated:
-        print(f"FAIL: {msg}", file=sys.stderr)
-    return 1 if gated else 0
+    print(f"lost_answers={report.get('lost_answers')} "
+          f"elapsed={report.get('elapsed_seconds')}s")
 
 
 if __name__ == "__main__":

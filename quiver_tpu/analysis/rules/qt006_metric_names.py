@@ -14,8 +14,11 @@ every ``telemetry.counter/gauge/histogram`` call:
     (durations), ``_bytes`` (sizes), ``_state`` (enum gauges),
     ``_level`` (ordinal gauges — the QoS degradation ladder),
     ``_lsn`` (log-sequence-number watermarks — WAL shipping lag),
-    ``_rows`` (row-count gauges — mesh frontier ownership), or
-    ``_members`` (membership-count gauges — fleet shard groups);
+    ``_rows`` (row-count gauges — mesh frontier ownership),
+    ``_members`` (membership-count gauges — fleet shard groups),
+    ``_replicas`` (replica-count gauges — autoscaler targets),
+    ``_rps`` (request-rate gauges — autoscaler predictions), or
+    ``_epoch`` (election-epoch ordinals — leader fencing);
   * label keys are literal keyword arguments — ``**labels`` expansion
     hides the key set from static inspection and is flagged.
 
@@ -37,7 +40,8 @@ from ..core import Finding, ModuleContext, Rule, dotted_call_name
 
 _FACTORIES = {"counter", "gauge", "histogram"}
 _UNIT_SUFFIXES = ("_total", "_seconds", "_bytes", "_state", "_level",
-                  "_lsn", "_rows", "_members")
+                  "_lsn", "_rows", "_members", "_replicas", "_rps",
+                  "_epoch")
 _SNAKE = re.compile(r"^[a-z][a-z0-9_]*$")
 # factory kwargs that are API options, not metric labels
 _OPTION_KWARGS = {"bounds", "help"}

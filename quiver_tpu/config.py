@@ -317,6 +317,83 @@ class Config:
     fleet_min_eligible: int = field(
         default_factory=lambda: _env("FLEET_MIN_ELIGIBLE", 1, int)
     )
+    # fleet autonomy (quiver_tpu/fleet/{election,walstream,autoscaler},
+    # docs/FLEET.md): all three subsystems are OFF by default and the
+    # off path is byte-identical — no threads, no metric keys, one
+    # config-string check at construction time.
+    #   election   — fenced leader auto-failover: followers race to
+    #                claim an epoch-stamped leadership record when the
+    #                leader's heartbeat expires; the epoch fences every
+    #                WAL append / membership write of a deposed leader
+    fleet_election: str = field(
+        default_factory=lambda: _env("FLEET_ELECTION", "off", str)
+    )
+    fleet_election_poll_s: float = field(
+        default_factory=lambda: _env("FLEET_ELECTION_POLL_S", 0.25, float)
+    )
+    # per-rank claim stagger: candidate rank r waits r * stagger before
+    # claiming, so the most-caught-up follower wins uncontested unless
+    # it too is dead (the O_EXCL claim keeps even a tie race safe)
+    fleet_election_stagger_s: float = field(
+        default_factory=lambda: _env("FLEET_ELECTION_STAGGER_S", 0.5,
+                                     float)
+    )
+    # how often a fenced writer re-reads the claim directory on the
+    # append path (0 = every append; tests use 0 for determinism)
+    fleet_election_fence_recheck_s: float = field(
+        default_factory=lambda: _env("FLEET_ELECTION_FENCE_RECHECK_S",
+                                     0.05, float)
+    )
+    #   walstream  — leader-side socket WAL shipping (JSON-lines frame
+    #                stream) so followers need no shared WAL directory
+    fleet_walstream: str = field(
+        default_factory=lambda: _env("FLEET_WALSTREAM", "off", str)
+    )
+    fleet_walstream_port: int = field(
+        default_factory=lambda: _env("FLEET_WALSTREAM_PORT", 0, int)
+    )
+    #   autoscaler — federation-driven spawn/drain control loop with a
+    #                diurnal-rate predictor, hysteresis and a cooldown
+    fleet_autoscaler: str = field(
+        default_factory=lambda: _env("FLEET_AUTOSCALER", "off", str)
+    )
+    fleet_autoscaler_interval_s: float = field(
+        default_factory=lambda: _env("FLEET_AUTOSCALER_INTERVAL_S", 1.0,
+                                     float)
+    )
+    fleet_autoscaler_min: int = field(
+        default_factory=lambda: _env("FLEET_AUTOSCALER_MIN", 1, int)
+    )
+    fleet_autoscaler_max: int = field(
+        default_factory=lambda: _env("FLEET_AUTOSCALER_MAX", 8, int)
+    )
+    fleet_autoscaler_cooldown_s: float = field(
+        default_factory=lambda: _env("FLEET_AUTOSCALER_COOLDOWN_S", 30.0,
+                                     float)
+    )
+    # serving capacity one replica is planned at, in requests/second —
+    # the unit the diurnal predictor's rate forecast is divided by
+    fleet_autoscaler_rps_per_replica: float = field(
+        default_factory=lambda: _env("FLEET_AUTOSCALER_RPS_PER_REPLICA",
+                                     200.0, float)
+    )
+    # prediction lead: scale for the rate expected this many seconds
+    # ahead (a warm join must complete before the ramp arrives)
+    fleet_autoscaler_horizon_s: float = field(
+        default_factory=lambda: _env("FLEET_AUTOSCALER_HORIZON_S", 10.0,
+                                     float)
+    )
+    # hysteresis band: scale up when predicted demand exceeds
+    # up_ratio * capacity, down only when it falls below down_ratio *
+    # capacity-after-drain — the gap is what prevents flapping
+    fleet_autoscaler_up_ratio: float = field(
+        default_factory=lambda: _env("FLEET_AUTOSCALER_UP_RATIO", 0.8,
+                                     float)
+    )
+    fleet_autoscaler_down_ratio: float = field(
+        default_factory=lambda: _env("FLEET_AUTOSCALER_DOWN_RATIO", 0.5,
+                                     float)
+    )
     # mesh-native sharded serving (quiver_tpu/mesh, docs/SHARDING.md):
     # number of row-range shards one logical replica spans (0 = off; the
     # whole mesh tier is dark and every code path is byte-identical to

@@ -17,20 +17,35 @@ losing an in-flight request (``benchmarks/fleet_chaos.py``).
   * :mod:`~quiver_tpu.fleet.federation` — the fleet observability
     plane: metrics federation, fleet SLOs, clock-aligned merged
     timelines, cross-process trace reconstruction
-    (docs/OBSERVABILITY.md).
+    (docs/OBSERVABILITY.md);
+  * :mod:`~quiver_tpu.fleet.election` — fenced leader auto-failover:
+    epoch-stamped exclusive claims, a fencing token on every write,
+    ranked follower promotion (``fleet_election=on``);
+  * :mod:`~quiver_tpu.fleet.walstream` — socket WAL shipping for
+    followers with no shared WAL filesystem (``fleet_walstream=on``);
+  * :mod:`~quiver_tpu.fleet.autoscaler` — federation-driven predictive
+    spawn/drain control loop (``fleet_autoscaler=on``).
 """
 
+from .autoscaler import DiurnalPredictor, FleetAutoscaler
+from .election import (ClaimRecord, ElectionDirectory, EpochFence,
+                       FencedWAL, LeaderElector, StaleEpochError)
 from .federation import (FleetFederation, FleetSLOWatchdog,
                          estimate_offsets, federate, federation_status,
                          get_federation, parse_prometheus_text)
 from .membership import FLEET_STATES, MembershipDirectory, ReplicaInfo
 from .replica import FleetReplica
 from .router import ConsistentHashRing, FleetRouter, fleet_status
-from .shipping import WALFollower
+from .shipping import TailFollower, WALFollower
+from .walstream import WALStreamFollower, WALStreamServer
 
 __all__ = [
     "FLEET_STATES", "MembershipDirectory", "ReplicaInfo", "FleetReplica",
     "ConsistentHashRing", "FleetRouter", "fleet_status", "WALFollower",
-    "FleetFederation", "FleetSLOWatchdog", "estimate_offsets", "federate",
-    "federation_status", "get_federation", "parse_prometheus_text",
+    "TailFollower", "FleetFederation", "FleetSLOWatchdog",
+    "estimate_offsets", "federate", "federation_status", "get_federation",
+    "parse_prometheus_text", "ClaimRecord", "ElectionDirectory",
+    "EpochFence", "FencedWAL", "LeaderElector", "StaleEpochError",
+    "WALStreamServer", "WALStreamFollower", "DiurnalPredictor",
+    "FleetAutoscaler",
 ]

@@ -62,6 +62,7 @@ class ReplicaInfo:
     staleness_lsn: int = 0
     staleness_seconds: float = 0.0
     wal_next_lsn: int = -1          # leaders: the shipping frontier
+    epoch: int = -1                 # leaders: the fencing epoch claimed
     detail: dict = field(default_factory=dict)
 
     def fresh(self, timeout_s: float, now: Optional[float] = None) -> bool:
@@ -100,7 +101,8 @@ class ReplicaInfo:
             "pid": self.pid, "heartbeat": self.heartbeat,
             "staleness_lsn": self.staleness_lsn,
             "staleness_seconds": self.staleness_seconds,
-            "wal_next_lsn": self.wal_next_lsn, "detail": self.detail,
+            "wal_next_lsn": self.wal_next_lsn, "epoch": self.epoch,
+            "detail": self.detail,
         }
 
     @classmethod
@@ -116,6 +118,7 @@ class ReplicaInfo:
             staleness_lsn=int(d.get("staleness_lsn", 0)),
             staleness_seconds=float(d.get("staleness_seconds", 0.0)),
             wal_next_lsn=int(d.get("wal_next_lsn", -1)),
+            epoch=int(d.get("epoch", -1)),
             detail=dict(d.get("detail", {})),
         )
 
@@ -216,6 +219,13 @@ class MembershipDirectory:
             try:
                 with open(os.path.join(self.root, name), "rb") as f:
                     info = ReplicaInfo.from_dict(json.loads(f.read()))
+            except FileNotFoundError:
+                # unlinked between listdir and open (deregister racing
+                # a scan): the member is simply gone, same treatment as
+                # any other unreadable record
+                telemetry.counter(
+                    "fleet_membership_parse_errors_total").inc()
+                continue
             except (OSError, ValueError, KeyError, TypeError):
                 # torn/garbage record: a membership scan must never die
                 # on one bad file
@@ -242,13 +252,23 @@ class MembershipDirectory:
         return None
 
     def leader(self) -> Optional[ReplicaInfo]:
-        """The fresh leader record, if any (single-writer: the newest
-        heartbeat wins if a stale duplicate lingers)."""
+        """The fresh leader record, if any — the one with the highest
+        fencing epoch.
+
+        During a failover there is a window where a deposed leader's
+        still-fresh record coexists with the successor's: the epoch is
+        the authority (the fence guarantees the higher epoch owns the
+        WAL), with heartbeat recency only as a tiebreak for epoch-less
+        legacy records.  Observing more than one fresh leader ticks
+        ``fleet_leader_conflicts_total`` — a conflict the fence makes
+        harmless but operators still want to see."""
         leaders = [r for r in self.replicas(fresh_only=True)
                    if r.role == "leader"]
         if not leaders:
             return None
-        return max(leaders, key=lambda r: r.heartbeat)
+        if len(leaders) > 1:
+            telemetry.counter("fleet_leader_conflicts_total").inc()
+        return max(leaders, key=lambda r: (r.epoch, r.heartbeat))
 
     def status(self) -> dict:
         """JSON view for ``/debug/fleet``."""

@@ -84,7 +84,8 @@ class FleetReplica:
         "_state": "_lock", "_stale": "_lock", "_inflight": "_lock",
         "_draining": "_lock", "_boot_seconds": "_lock",
         "manager": "_lock", "graph": "_lock", "follower": "_lock",
-        "_server": "_lock", "metrics_server": "_lock",
+        "_server": "_lock", "metrics_server": "_lock", "epoch": "_lock",
+        "role": "_lock", "walstream_server": "_lock",
     }
 
     def __init__(self, replica_id: str, fleet_dir: Optional[str] = None,
@@ -143,8 +144,19 @@ class FleetReplica:
         self.graph = None
         self.manager = None           # leader only (RecoveryManager)
         self.lane = None              # leader only (IngestLane)
-        self.follower: Optional[WALFollower] = None  # follower only
+        self.follower = None          # follower only (TailFollower)
         self.metrics_server = None
+        # fleet-autonomy flags resolved ONCE at construction: the off
+        # path must stay byte-identical — no election/walstream import,
+        # no extra threads, no new metric keys
+        self._election_enabled = str(cfg.fleet_election).lower() in (
+            "on", "1", "true", "yes")
+        self._walstream_enabled = str(cfg.fleet_walstream).lower() in (
+            "on", "1", "true", "yes")
+        self.elector = None           # LeaderElector when election is on
+        self.fence = None             # EpochFence while leading, fenced
+        self.epoch = -1               # the fencing epoch currently held
+        self.walstream_server = None  # WALStreamServer while leading
         self._lock = threading.Lock()
         self._state = "booting"
         self._stale = True
@@ -215,6 +227,13 @@ class FleetReplica:
                         replica=self.replica_id).set(boot_seconds)
         self._set_state("serving", stale=False)
         self._start_heartbeat()
+        if self._election_enabled:
+            # leaders already claimed in _boot_leader; followers get a
+            # fresh elector.  The loop watches for leader death
+            # (followers) and deposition (leaders) from here on.
+            if self.elector is None:
+                self.elector = self._make_elector()
+            self.elector.start()
         return self
 
     def _boot_leader(self) -> None:
@@ -229,8 +248,15 @@ class FleetReplica:
             self.graph = self.manager.boot_degraded()
         self._set_state("replaying", stale=True)
         self.manager.finish_boot(warmup=self.warmup, seal=self.seal)
+        if self._election_enabled:
+            # claim an epoch BEFORE the first fenced append: a booting
+            # configured leader outranks any dead predecessor's claim
+            self.elector = self._make_elector()
+            self._install_fence(self.elector.claim_initial())
         self.lane = IngestLane(self.graph).start()
         self.manager.attach_lane(self.lane)
+        if self._walstream_enabled:
+            self._start_walstream()
         self._set_state("warming", stale=False)
 
     def _boot_follower(self) -> None:
@@ -247,12 +273,7 @@ class FleetReplica:
                 cfg.recovery_cache_dir)
         start_lsn = self._restore_from_checkpoint()
         self._set_state("replaying", stale=True)
-        with self._lock:
-            self.follower = WALFollower(
-                self.wal_dir, apply_fn=self._apply_shipped,
-                start_lsn=start_lsn,
-                resync_fn=self._resync_from_checkpoint,
-                name=self.replica_id).start()
+        self._start_follower(start_lsn)
         self._await_catchup()
         self._set_state("warming", stale=False)
         if self.warmup is not None:
@@ -261,6 +282,39 @@ class FleetReplica:
             from ..recovery.registry import get_program_registry
 
             get_program_registry().seal()
+
+    def _start_follower(self, start_lsn: int) -> None:
+        """Start the WAL tail — file tail over the shared directory, or
+        the socket tail when ``fleet_walstream`` is on (no shared WAL
+        filesystem required; the endpoint is re-resolved from membership
+        on every reconnect, so a failover moves the tail by itself)."""
+        if self._walstream_enabled:
+            from .walstream import WALStreamFollower
+
+            follower = WALStreamFollower(
+                self._walstream_endpoint, apply_fn=self._apply_shipped,
+                start_lsn=start_lsn,
+                resync_fn=self._resync_from_checkpoint,
+                name=self.replica_id)
+        else:
+            follower = WALFollower(
+                self.wal_dir, apply_fn=self._apply_shipped,
+                start_lsn=start_lsn,
+                resync_fn=self._resync_from_checkpoint,
+                name=self.replica_id)
+        with self._lock:
+            self.follower = follower.start()
+
+    def _walstream_endpoint(self):
+        """The current leader's stream endpoint per membership, or None
+        while there is no fresh leader (the follower just re-polls)."""
+        leader = self.directory.leader()
+        if leader is None:
+            return None
+        port = leader.detail.get("walstream_port")
+        if not port:
+            return None
+        return (leader.host, int(port))
 
     def _restore_from_checkpoint(self) -> int:
         """Restore the newest shared checkpoint into ``self.graph``;
@@ -314,6 +368,122 @@ class FleetReplica:
         raise RecoveryError(
             f"replica {self.replica_id} not caught up within "
             f"{self.catchup_timeout_s}s: {self.follower.status()}")
+
+    # -- election / failover ------------------------------------------
+    def _applied_lsn(self) -> int:
+        """Candidacy currency: the newest LSN this replica has folded in
+        (followers: the tail's commit cursor; leaders: the append
+        frontier)."""
+        follower = self.follower
+        if follower is not None:
+            return int(follower.applied_lsn)
+        manager = self.manager
+        if manager is not None and manager.wal is not None:
+            return int(manager.wal.next_lsn) - 1
+        return -1
+
+    def _make_elector(self):
+        from .election import LeaderElector
+
+        return LeaderElector(
+            self.directory, self.replica_id,
+            applied_lsn_fn=self._applied_lsn,
+            role_fn=lambda: self.role,
+            promote_fn=self._promote, demote_fn=self._step_down)
+
+    def _install_fence(self, claim) -> None:
+        """Wrap the manager's WAL in the epoch fence — every append /
+        roll / truncate from here on carries the claimed epoch, and a
+        deposed write raises before a byte lands."""
+        from .election import EpochFence, FencedWAL
+
+        self.fence = EpochFence(self.elector.election_dir, claim.epoch,
+                                self.replica_id)
+        # quiverlint: ignore[QT008] -- atomic reference publish: the
+        # heartbeat thread only reads `.next_lsn`, which both the raw
+        # WAL and the FencedWAL wrapper (delegating __getattr__) answer
+        # identically; the checkpointer of this manager starts only
+        # after this call (happens-before via Thread.start)
+        self.manager.wal = FencedWAL(self.manager.wal, self.fence)
+        with self._lock:
+            self.epoch = int(claim.epoch)
+
+    def _start_walstream(self) -> None:
+        from .walstream import WALStreamServer
+
+        server = WALStreamServer(
+            self.wal_dir, host=self.host, name=self.replica_id,
+            fence=self.fence)
+        with self._lock:
+            self.walstream_server = server
+
+    def _promote(self, claim) -> None:
+        """Election won (elector thread): adopt the WAL this replica has
+        been tailing and become the leader.  The follower's holdback
+        semantics carry straight through — its commit cursor is the
+        adopt watermark, so a record it was still holding back is folded
+        (or aborted) by the manager's two-pass replay, never twice."""
+        from ..recovery.manager import RecoveryManager
+        from ..stream import IngestLane
+
+        log.warning("replica %s promoting to leader (epoch %d)",
+                    self.replica_id, claim.epoch)
+        follower = self.follower
+        applied = -1
+        if follower is not None:
+            follower.stop()
+            applied = int(follower.applied_lsn)
+            with self._lock:
+                self.follower = None
+        with self._lock:
+            self.role = "leader"
+        manager = RecoveryManager(self.root,
+                                  graph_factory=self.graph_factory)
+        manager.adopt(self.graph, applied)
+        with self._lock:
+            # adopt may have fallen back to a checkpoint boot (late
+            # abort across the failover) and built a fresh graph
+            self.manager = manager
+            self.graph = manager.graph
+        self._install_fence(claim)
+        self.lane = IngestLane(self.graph).start()
+        manager.attach_lane(self.lane)
+        manager.start_checkpointer()
+        if self._walstream_enabled:
+            self._start_walstream()
+        self._announce()
+
+    def _step_down(self, claim) -> None:
+        """Deposed (elector thread): a higher epoch exists.  Stop every
+        write-side component — the fence already refuses appends, this
+        makes the stop graceful — and rejoin as a follower of the new
+        leader from the exact frontier this process reached."""
+        log.warning("replica %s deposed by %s (epoch %d); rejoining as "
+                    "follower", self.replica_id, claim.leader_id,
+                    claim.epoch)
+        telemetry.counter("fleet_election_demotions_total",
+                          replica=self.replica_id).inc()
+        if self.walstream_server is not None:
+            self.walstream_server.stop()
+            with self._lock:
+                self.walstream_server = None
+        if self.lane is not None:
+            self.lane.stop()
+            self.lane = None
+        manager = self.manager
+        applied = -1
+        if manager is not None:
+            if manager.wal is not None:
+                applied = int(manager.wal.next_lsn) - 1
+            manager.close()
+            with self._lock:
+                self.manager = None
+        self.fence = None
+        with self._lock:
+            self.role = "follower"
+            self.epoch = -1
+        self._start_follower(applied)
+        self._announce()
 
     # -- serving endpoint ---------------------------------------------
     def _start_server(self) -> None:
@@ -483,12 +653,21 @@ class FleetReplica:
             detail["shard_group"] = self.shard_group
             detail["shard_index"] = self.shard_index
             detail["shard_count"] = self.shard_count
+        if self.walstream_server is not None:
+            detail["walstream_port"] = self.walstream_server.port
+        wal_next = int(health.get("wal_next_lsn", -1))
+        if wal_next < 0 and "applied_lsn" in health:
+            # followers publish their fold frontier too — it is the
+            # candidacy currency the election ranks promotions by
+            wal_next = int(health["applied_lsn"]) + 1
+        with self._lock:
+            epoch = self.epoch
         return ReplicaInfo(
             replica_id=self.replica_id, state=self.state, host=self.host,
             port=self.port, role=self.role, pid=os.getpid(),
             staleness_lsn=int(health.get("staleness_lsn", 0)),
             staleness_seconds=float(health.get("staleness_seconds", 0.0)),
-            wal_next_lsn=int(health.get("wal_next_lsn", -1)),
+            wal_next_lsn=wal_next, epoch=epoch,
             detail=detail,
         )
 
@@ -528,11 +707,21 @@ class FleetReplica:
                 if self._inflight == 0:
                     break
             time.sleep(0.01)
+        # stop heartbeating BEFORE deregistering: a beat landing after
+        # the unlink would resurrect the record as a ghost member
+        self._hb_stop.set()
         self.directory.deregister(self.replica_id)
 
     def stop(self, timeout: float = 5.0) -> None:
         from ..resilience.shutdown import join_and_reap
 
+        if self.elector is not None:
+            self.elector.stop(timeout)
+            self.elector = None
+        if self.walstream_server is not None:
+            self.walstream_server.stop(timeout)
+            with self._lock:
+                self.walstream_server = None
         self._hb_stop.set()
         threads = []
         if self._hb_thread is not None:

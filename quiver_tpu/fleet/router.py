@@ -135,7 +135,7 @@ class FleetRouter:
     _guarded_by = {
         "_eligible": "_lock", "_health_ok": "_lock", "_inflight": "_lock",
         "_last_scan": "_lock", "_hops": "_lock", "_hop_ids": "_lock",
-        "_groups": "_lock",
+        "_groups": "_lock", "_write_path": "_lock",
     }
 
     def __init__(self, directory: MembershipDirectory,
@@ -173,6 +173,7 @@ class FleetRouter:
         self._health_ok: Dict[str, bool] = {}
         self._inflight: Dict[str, int] = {}
         self._last_scan = 0.0
+        self._write_path = None       # (leader_id, epoch) last resolved
         self._hp_stop = threading.Event()
         self._hp_thread: Optional[threading.Thread] = None
         # fleet observability plane (docs/OBSERVABILITY.md): the flag is
@@ -281,6 +282,36 @@ class FleetRouter:
             target=_loop, daemon=True, name="quiver-fleet-health")
         self._hp_thread.start()
         return self
+
+    # -- write path (leader resolution) --------------------------------
+    def write_path(self) -> Optional[ReplicaInfo]:
+        """The current write endpoint: the fleet's fresh leader record,
+        epoch-aware.
+
+        Keyed by ``(leader_id, epoch)`` — when a fenced failover moves
+        the epoch, the next call observes the change, ticks
+        ``fleet_router_write_path_changes_total`` and hands back the
+        successor, so writers re-resolve instead of appending at a
+        deposed leader's endpoint (whose fence would refuse them
+        anyway; this avoids even sending the bytes).  Returns None
+        while no fresh leader exists (mid-failover window).  The metric
+        is only created once a write path actually moves — a read-only
+        fleet never grows a key."""
+        leader = self.directory.leader()
+        if leader is None:
+            with self._lock:
+                self._write_path = None
+            return None
+        key = (leader.replica_id, leader.epoch)
+        with self._lock:
+            prev = self._write_path
+            self._write_path = key
+        if prev is not None and prev != key:
+            telemetry.counter(
+                "fleet_router_write_path_changes_total").inc()
+            log.warning("fleet write path moved: %s (epoch %d) -> %s "
+                        "(epoch %d)", prev[0], prev[1], key[0], key[1])
+        return leader
 
     # -- placement -----------------------------------------------------
     def partition_of(self, ids) -> int:

@@ -1,12 +1,20 @@
-"""WAL shipping: a read-only follower tail over the leader's log.
+"""WAL shipping: read-only follower tails over the leader's log.
 
 The fleet keeps writes single-writer — one ingest leader appends to the
-WAL (``recovery/wal.py``) — and read replicas *tail* the same segment
-files, folding acked records into their own graph.  The follower never
-opens the log for writing (a :class:`~quiver_tpu.recovery.wal.
-WriteAheadLog` constructor would truncate the leader's live torn tail),
-it only reads bytes and walks ``blockio.scan_records`` frames, so any
-number of followers can ship from one leader directory.
+WAL (``recovery/wal.py``) — and read replicas *tail* the log, folding
+acked records into their own graph.  Two transports share one
+catch-up/holdback core (:class:`TailFollower`):
+
+  * :class:`WALFollower` (here) reads the leader's segment files
+    directly — the shared-filesystem deployment.  It never opens the
+    log for writing (a :class:`~quiver_tpu.recovery.wal.WriteAheadLog`
+    constructor would truncate the leader's live torn tail), it only
+    reads bytes and walks ``blockio.scan_records`` frames, so any
+    number of followers can ship from one leader directory.
+  * :class:`~quiver_tpu.fleet.walstream.WALStreamFollower` receives
+    the same frames over a TCP JSON-lines stream from the leader's
+    :class:`~quiver_tpu.fleet.walstream.WALStreamServer` — fleets with
+    no shared filesystem.  Same holdback, same staleness contract.
 
 Three live-tailing realities shape the loop:
 
@@ -32,11 +40,11 @@ Three live-tailing realities shape the loop:
     (``fleet_ship_resyncs_total``) instead of stranding.
 
 Staleness is measured, not assumed: ``fleet_replica_staleness_lsn`` is
-the distance between the last LSN visible on disk and the last LSN
-folded into the follower's graph; ``fleet_replica_staleness_seconds``
-is how long the follower has been behind (0 while caught up).  The
-staleness contract the router and the chaos harness rely on is in
-docs/FLEET.md.
+the distance between the last LSN visible (on disk, or past the stream
+frontier) and the last LSN folded into the follower's graph;
+``fleet_replica_staleness_seconds`` is how long the follower has been
+behind (0 while caught up).  The staleness contract the router and the
+chaos harness rely on is in docs/FLEET.md.
 """
 
 from __future__ import annotations
@@ -54,7 +62,7 @@ from ..recovery.errors import WALError
 from ..recovery.wal import decode_abort, decode_edge_op
 from ..resilience import chaos
 
-__all__ = ["WALFollower"]
+__all__ = ["TailFollower", "WALFollower", "list_segments", "scan_frames"]
 
 log = logging.getLogger("quiver_tpu.fleet")
 
@@ -65,14 +73,56 @@ _CHAOS_SHIP = chaos.point("fleet.ship")
 _SEG_RE = re.compile(r"^wal-(\d{20})\.seg$")
 
 
-class WALFollower:
-    """Tail one leader WAL directory, applying committed records.
+def list_segments(wal_dir: str) -> List[Tuple[int, str]]:
+    """``(start_lsn, path)`` per segment under ``wal_dir``, sorted.
+    Shared by the file follower and the walstream server — both read
+    the leader's layout, neither owns it."""
+    out = []
+    try:
+        names = os.listdir(wal_dir)
+    except OSError:
+        return []
+    for n in names:
+        m = _SEG_RE.match(n)
+        if m:
+            out.append((int(m.group(1)), os.path.join(wal_dir, n)))
+    out.sort()
+    return out
 
-    ``apply_fn(lsn, op, src, dst, ts)`` runs on the follower thread for
-    every committed edge op (aborted records are skipped).  ``resync_fn``
-    is called when the follower is stranded (truncation gap or late
-    abort); it must re-restore follower state from the newest shared
-    checkpoint and return the next LSN to resume from.
+
+def scan_frames(data: bytes):
+    """``(kind, payload, start_offset, end_offset)`` per complete frame,
+    plus a trailing ``torn`` flag — end offsets come from the *next*
+    frame's start, which is the only way to bound a corrupt frame.
+    ``data[start:end]`` is the raw frame (header + payload), which is
+    what the walstream server ships so receivers re-verify the disk
+    bytes, not a re-framed copy."""
+    raw = list(blockio.scan_records(data))
+    torn = bool(raw) and raw[-1][0] == "torn"
+    usable = raw[:-1] if torn else raw
+    frames = []
+    for i, (kind, off, payload) in enumerate(usable):
+        if i + 1 < len(usable):
+            end = usable[i + 1][1]
+        elif torn:
+            end = raw[-1][1]
+        else:
+            end = len(data)
+        frames.append((kind, payload, off, end))
+    return frames, torn
+
+
+class TailFollower:
+    """The transport-independent catch-up/holdback core.
+
+    Subclasses implement :meth:`poll_once` (one tailing pass over their
+    transport) and feed every visible slot — in LSN order — through
+    :meth:`_observe`; the core resolves abort holdback, commits decoded
+    edge ops through ``apply_fn(lsn, op, src, dst, ts)``, counts, and
+    publishes the staleness gauges.  ``resync_fn`` is called when the
+    follower is stranded (truncation gap or late abort); it must
+    re-restore follower state from the newest shared checkpoint and
+    return the next LSN to resume from.
     """
 
     _guarded_by = {
@@ -81,18 +131,18 @@ class WALFollower:
         "_caught_up_at": "_lock", "_last_error": "_lock",
     }
 
-    def __init__(self, wal_dir: str,
+    def __init__(self,
                  apply_fn: Callable[[int, str, object, object, object],
                                     None],
                  start_lsn: int = -1,
                  resync_fn: Optional[Callable[[], int]] = None,
                  poll_interval_s: Optional[float] = None,
                  grace_s: Optional[float] = None,
-                 name: str = "follower"):
+                 name: str = "follower",
+                 thread_prefix: str = "quiver-fleet-ship"):
         from ..config import get_config
 
         cfg = get_config()
-        self.wal_dir = str(wal_dir)
         self.apply_fn = apply_fn
         self.resync_fn = resync_fn
         self.name = str(name)
@@ -109,19 +159,17 @@ class WALFollower:
         self._staleness_seconds = 0.0
         self._caught_up_at = time.monotonic()
         self._last_error: Optional[str] = None
-        # follower-thread-private tail cursor (single thread root — the
-        # poll loop; unit tests drive poll_once() from one thread too):
-        self._seg_start: Optional[int] = None  # start LSN of open segment
-        self._offset = 0                       # frame-boundary byte offset
+        # follower-thread-private holdback slot (single thread root —
+        # the poll loop; unit tests drive poll_once() from one thread
+        # too): (lsn, payload, observed_at)
         self._held: Optional[Tuple[int, bytes, float]] = None
-        self._torn_waiting = False
         self._stop_evt = threading.Event()
         self._thread = threading.Thread(
             target=self._run, daemon=True,
-            name=f"quiver-fleet-ship-{self.name}")
+            name=f"{thread_prefix}-{self.name}")
 
     # -- lifecycle -----------------------------------------------------
-    def start(self) -> "WALFollower":
+    def start(self) -> "TailFollower":
         self._thread.start()
         return self
 
@@ -131,6 +179,7 @@ class WALFollower:
         self._stop_evt.set()
         if self._thread.is_alive():
             join_and_reap([self._thread], timeout, component="fleet.ship")
+        self._close_transport()
 
     def is_running(self) -> bool:
         return self._thread.is_alive()
@@ -147,38 +196,194 @@ class WALFollower:
                 log.warning("wal follower %s poll failed: %s", self.name, e)
             self._stop_evt.wait(self.poll_interval_s)
 
-    # -- tailing -------------------------------------------------------
-    def _segments(self) -> List[Tuple[int, str]]:
-        out = []
+    # -- transport hooks -----------------------------------------------
+    def poll_once(self) -> int:
+        """One tailing pass; returns records committed.  Public so unit
+        tests can drive the loop deterministically without the thread."""
+        raise NotImplementedError
+
+    def _reset_cursor(self) -> None:
+        """Drop transport-side position state after a resync — the next
+        poll re-derives it from ``_next_lsn``."""
+
+    def _close_transport(self) -> None:
+        """Release transport resources on stop (sockets, handles)."""
+
+    # -- the holdback core ---------------------------------------------
+    def _committed_next(self) -> int:
+        with self._lock:
+            return self._next_lsn
+
+    def _visible_next(self) -> int:
+        return self._committed_next() + (1 if self._held is not None else 0)
+
+    def _resync(self, reason: str) -> None:
+        telemetry.counter("fleet_ship_resyncs_total",
+                          replica=self.name).inc()
+        log.warning("wal follower %s resyncing from checkpoint (%s)",
+                    self.name, reason)
+        if self.resync_fn is None:
+            with self._lock:
+                self._last_error = f"stranded ({reason}), no resync_fn"
+            raise WALError(f"follower {self.name} stranded: {reason}")
+        next_lsn = int(self.resync_fn())
+        with self._lock:
+            self._next_lsn = next_lsn
+            self._resyncs += 1
+            self._last_error = None
+        self._held = None
+        self._reset_cursor()
+
+    def _observe(self, lsn: int, payload: Optional[bytes]) -> int:
+        """One visible slot: resolve the held predecessor, then hold or
+        commit this one.  Returns records committed.  ``payload`` is
+        None for a corrupt slot (consumes its LSN, carries no op)."""
+        committed = 0
+        target = decode_abort(payload) if payload is not None else None
+        if self._held is not None:
+            held_lsn, held_payload, _t0 = self._held
+            self._held = None
+            if target is not None and target == held_lsn:
+                # the holdback worked: skip the aborted record and
+                # consume the abort's own slot in one step — this is
+                # NOT a late abort, the target was never applied
+                telemetry.counter("fleet_ship_aborted_total",
+                                  replica=self.name).inc()
+                self._advance(lsn)
+                return committed
+            committed += self._commit(held_lsn, held_payload)
+        if target is not None:
+            if target < self._committed_next():
+                # abort for a record we already applied: the grace
+                # window was beaten — state diverged, rebuild it
+                telemetry.counter("fleet_ship_late_aborts_total",
+                                  replica=self.name).inc()
+                self._advance(lsn)  # consume the abort's own slot
+                self._resync(f"late abort for lsn {target}")
+                return committed
+            # the abort's own slot commits immediately (nothing can
+            # cancel an abort)
+            self._advance(lsn)
+        elif payload is None:
+            # corrupt frame: consumes its LSN slot, carries no op
+            telemetry.counter("recovery_wal_corrupt_records_total").inc()
+            self._advance(lsn)
+        else:
+            self._held = (lsn, payload, time.monotonic())
+        return committed
+
+    def _flush_held(self) -> int:
+        """Commit the held tail record once its grace window expires —
+        the no-successor-visible path (idle leader)."""
+        if self._held is None:
+            return 0
+        held_lsn, payload, t0 = self._held
+        if (time.monotonic() - t0) >= self.grace_s:
+            self._held = None
+            return self._commit(held_lsn, payload)
+        return 0
+
+    def _commit(self, lsn: int, payload: bytes) -> int:
         try:
-            names = os.listdir(self.wal_dir)
-        except OSError:
-            return []
-        for n in names:
-            m = _SEG_RE.match(n)
-            if m:
-                out.append((int(m.group(1)), os.path.join(self.wal_dir, n)))
-        out.sort()
+            op, src, dst, ts = decode_edge_op(payload)
+        except WALError as e:
+            log.warning("follower %s: undecodable record at lsn %d: %s",
+                        self.name, lsn, e)
+            self._advance(lsn)
+            return 0
+        self.apply_fn(lsn, op, src, dst, ts)
+        with self._lock:
+            self._next_lsn = lsn + 1
+            self._records += 1
+        telemetry.counter("fleet_ship_records_total",
+                          replica=self.name).inc()
+        return 1
+
+    def _advance(self, lsn: int) -> None:
+        with self._lock:
+            self._next_lsn = lsn + 1
+
+    def _extra_lag(self) -> int:
+        """Transport-visible lag beyond the held slot (the stream
+        follower knows the leader's frontier from keepalives; file
+        followers see exactly what is on disk)."""
+        return 0
+
+    def _publish_staleness(self) -> None:
+        """Distance between what is visible and what is applied.  The
+        held-back tail record counts as visible-but-unapplied (honest:
+        it IS behind, bounded by the grace window)."""
+        lag = (1 if self._held is not None else 0) + self._extra_lag()
+        now = time.monotonic()
+        with self._lock:
+            self._staleness_lsn = lag
+            if lag == 0:
+                self._caught_up_at = now
+                self._staleness_seconds = 0.0
+            else:
+                self._staleness_seconds = max(now - self._caught_up_at, 0.0)
+            s_lsn, s_sec = self._staleness_lsn, self._staleness_seconds
+        telemetry.gauge("fleet_replica_staleness_lsn",
+                        replica=self.name).set(float(s_lsn))
+        telemetry.gauge("fleet_replica_staleness_seconds",
+                        replica=self.name).set(s_sec)
+
+    # -- read side -----------------------------------------------------
+    @property
+    def applied_lsn(self) -> int:
+        with self._lock:
+            return self._next_lsn - 1
+
+    def status(self) -> dict:
+        with self._lock:
+            out = {
+                "name": self.name,
+                "applied_lsn": self._next_lsn - 1,
+                "records": self._records,
+                "resyncs": self._resyncs,
+                "staleness_lsn": self._staleness_lsn,
+                "staleness_seconds": round(self._staleness_seconds, 3),
+                "last_error": self._last_error,
+            }
+        out["running"] = self._thread.is_alive()
         return out
 
-    @staticmethod
-    def _frames(data: bytes):
-        """``(kind, payload, end_offset)`` per complete frame, plus a
-        trailing ``torn`` flag — end offsets come from the *next* frame's
-        start, which is the only way to bound a corrupt frame."""
-        raw = list(blockio.scan_records(data))
-        torn = bool(raw) and raw[-1][0] == "torn"
-        usable = raw[:-1] if torn else raw
-        frames = []
-        for i, (kind, off, payload) in enumerate(usable):
-            if i + 1 < len(usable):
-                end = usable[i + 1][1]
-            elif torn:
-                end = raw[-1][1]
-            else:
-                end = len(data)
-            frames.append((kind, payload, end))
-        return frames, torn
+
+class WALFollower(TailFollower):
+    """Tail one leader WAL directory, applying committed records.
+
+    The shared-filesystem transport over :class:`TailFollower`: walks
+    segment files with a frame-boundary byte cursor, waits on torn
+    tails, rotates at sealed segment ends, and resyncs across
+    truncation gaps.
+    """
+
+    def __init__(self, wal_dir: str,
+                 apply_fn: Callable[[int, str, object, object, object],
+                                    None],
+                 start_lsn: int = -1,
+                 resync_fn: Optional[Callable[[], int]] = None,
+                 poll_interval_s: Optional[float] = None,
+                 grace_s: Optional[float] = None,
+                 name: str = "follower"):
+        super().__init__(apply_fn, start_lsn=start_lsn,
+                         resync_fn=resync_fn,
+                         poll_interval_s=poll_interval_s, grace_s=grace_s,
+                         name=name, thread_prefix="quiver-fleet-ship")
+        self.wal_dir = str(wal_dir)
+        # follower-thread-private tail cursor (single thread root — the
+        # poll loop; unit tests drive poll_once() from one thread too):
+        self._seg_start: Optional[int] = None  # start LSN of open segment
+        self._offset = 0                       # frame-boundary byte offset
+        self._torn_waiting = False
+
+    # -- tailing -------------------------------------------------------
+    def _segments(self) -> List[Tuple[int, str]]:
+        return list_segments(self.wal_dir)
+
+    def _reset_cursor(self) -> None:
+        self._seg_start = None
+        self._offset = 0
 
     def _reposition(self, segs: List[Tuple[int, str]]) -> bool:
         """Point the cursor at the segment containing ``_next_lsn``;
@@ -193,9 +398,9 @@ class WALFollower:
                 data = f.read()
         except OSError:
             return False
-        frames, _torn = self._frames(data)
+        frames, _torn = scan_frames(data)
         slot, offset = start, 0
-        for _kind, _payload, end in frames:
+        for _kind, _payload, _off, end in frames:
             if slot >= target:
                 break
             slot += 1
@@ -214,24 +419,6 @@ class WALFollower:
         # quiverlint: ignore[QT008] -- single-driver tail cursor (above)
         self._held = None
         return True
-
-    def _resync(self, reason: str) -> None:
-        telemetry.counter("fleet_ship_resyncs_total",
-                          replica=self.name).inc()
-        log.warning("wal follower %s resyncing from checkpoint (%s)",
-                    self.name, reason)
-        if self.resync_fn is None:
-            with self._lock:
-                self._last_error = f"stranded ({reason}), no resync_fn"
-            raise WALError(f"follower {self.name} stranded: {reason}")
-        next_lsn = int(self.resync_fn())
-        with self._lock:
-            self._next_lsn = next_lsn
-            self._resyncs += 1
-            self._last_error = None
-        self._seg_start = None
-        self._offset = 0
-        self._held = None
 
     def poll_once(self) -> int:
         """One tailing pass; returns records committed.  Public so unit
@@ -271,20 +458,22 @@ class WALFollower:
             except OSError:
                 break
             base = self._offset
-            frames, torn = self._frames(chunk)
+            frames, torn = scan_frames(chunk)
             stranded = False
-            for kind, payload, end in frames:
+            for kind, payload, _off, end in frames:
                 # quiverlint: ignore[QT008] -- single-driver tail cursor
                 self._torn_waiting = False
                 # the chunk starts at the next unobserved slot and slots
                 # are consumed in order, so the frame's LSN is implied
                 lsn = self._visible_next()
                 committed += self._observe(
-                    lsn, payload if kind == "ok" else None, base + end)
+                    lsn, payload if kind == "ok" else None)
                 if self._seg_start != start:
                     # a late abort resynced mid-scan; restart the walk
                     stranded = True
                     break
+                # quiverlint: ignore[QT008] -- single-driver tail cursor
+                self._offset = base + end
             if stranded:
                 segs = self._segments()
                 continue
@@ -313,121 +502,3 @@ class WALFollower:
         committed += self._flush_held()
         self._publish_staleness()
         return committed
-
-    def _committed_next(self) -> int:
-        with self._lock:
-            return self._next_lsn
-
-    def _visible_next(self) -> int:
-        return self._committed_next() + (1 if self._held is not None else 0)
-
-    def _observe(self, lsn: int, payload: Optional[bytes],
-                 offset_after: int) -> int:
-        """One visible slot: resolve the held predecessor, then hold or
-        commit this one.  Returns records committed."""
-        committed = 0
-        target = decode_abort(payload) if payload is not None else None
-        if self._held is not None:
-            held_lsn, held_payload, _t0 = self._held
-            self._held = None
-            if target is not None and target == held_lsn:
-                # the holdback worked: skip the aborted record and
-                # consume the abort's own slot in one step — this is
-                # NOT a late abort, the target was never applied
-                telemetry.counter("fleet_ship_aborted_total",
-                                  replica=self.name).inc()
-                self._advance(lsn)
-                self._offset = offset_after
-                return committed
-            committed += self._commit(held_lsn, held_payload)
-        if target is not None:
-            if target < self._committed_next():
-                # abort for a record we already applied: the grace
-                # window was beaten — state diverged, rebuild it
-                telemetry.counter("fleet_ship_late_aborts_total",
-                                  replica=self.name).inc()
-                self._advance(lsn)  # consume the abort's own slot
-                self._offset = offset_after
-                self._resync(f"late abort for lsn {target}")
-                return committed
-            # the abort's own slot commits immediately (nothing can
-            # cancel an abort)
-            self._advance(lsn)
-        elif payload is None:
-            # corrupt frame: consumes its LSN slot, carries no op
-            telemetry.counter("recovery_wal_corrupt_records_total").inc()
-            self._advance(lsn)
-        else:
-            self._held = (lsn, payload, time.monotonic())
-        self._offset = offset_after
-        return committed
-
-    def _flush_held(self) -> int:
-        """Commit the held tail record once its grace window expires —
-        the no-successor-visible path (idle leader)."""
-        if self._held is None:
-            return 0
-        held_lsn, payload, t0 = self._held
-        if (time.monotonic() - t0) >= self.grace_s:
-            self._held = None
-            return self._commit(held_lsn, payload)
-        return 0
-
-    def _commit(self, lsn: int, payload: bytes) -> int:
-        try:
-            op, src, dst, ts = decode_edge_op(payload)
-        except WALError as e:
-            log.warning("follower %s: undecodable record at lsn %d: %s",
-                        self.name, lsn, e)
-            self._advance(lsn)
-            return 0
-        self.apply_fn(lsn, op, src, dst, ts)
-        with self._lock:
-            self._next_lsn = lsn + 1
-            self._records += 1
-        telemetry.counter("fleet_ship_records_total",
-                          replica=self.name).inc()
-        return 1
-
-    def _advance(self, lsn: int) -> None:
-        with self._lock:
-            self._next_lsn = lsn + 1
-
-    def _publish_staleness(self) -> None:
-        """Distance between what is on disk and what is applied.  The
-        held-back tail record counts as visible-but-unapplied (honest:
-        it IS behind, bounded by the grace window)."""
-        lag = 1 if self._held is not None else 0
-        now = time.monotonic()
-        with self._lock:
-            self._staleness_lsn = lag
-            if lag == 0:
-                self._caught_up_at = now
-                self._staleness_seconds = 0.0
-            else:
-                self._staleness_seconds = max(now - self._caught_up_at, 0.0)
-            s_lsn, s_sec = self._staleness_lsn, self._staleness_seconds
-        telemetry.gauge("fleet_replica_staleness_lsn",
-                        replica=self.name).set(float(s_lsn))
-        telemetry.gauge("fleet_replica_staleness_seconds",
-                        replica=self.name).set(s_sec)
-
-    # -- read side -----------------------------------------------------
-    @property
-    def applied_lsn(self) -> int:
-        with self._lock:
-            return self._next_lsn - 1
-
-    def status(self) -> dict:
-        with self._lock:
-            out = {
-                "name": self.name,
-                "applied_lsn": self._next_lsn - 1,
-                "records": self._records,
-                "resyncs": self._resyncs,
-                "staleness_lsn": self._staleness_lsn,
-                "staleness_seconds": round(self._staleness_seconds, 3),
-                "last_error": self._last_error,
-            }
-        out["running"] = self._thread.is_alive()
-        return out

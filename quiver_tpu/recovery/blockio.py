@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import os
 import struct
+import threading
 from typing import Iterator, Optional, Tuple
 
 __all__ = [
@@ -144,23 +145,47 @@ def fsync_dir(path: str) -> None:
         os.close(fd)
 
 
-def atomic_publish(path: str, data: bytes) -> None:
-    """Publish ``data`` at ``path`` atomically: tmp + fsync + rename.
+def atomic_publish(path: str, data: bytes, exclusive: bool = False) -> bool:
+    """Publish ``data`` at ``path`` atomically: tmp + fsync + commit.
 
     A crash at any instant leaves either the previous file (or nothing)
-    or the complete new one — the rename is the commit point.  Stray
+    or the complete new one — the commit is the atomic point.  Stray
     ``*.tmp.<pid>`` files from a crashed writer are garbage readers
     must ignore (the checkpoint loader filters on the final name).
+
+    Two commit modes:
+
+      * default — ``os.rename``: last writer wins, readers always see
+        a complete file (membership records, checkpoints);
+      * ``exclusive=True`` — ``os.link``: the commit FAILS if ``path``
+        already exists, making the publish a filesystem compare-and-
+        swap.  Returns False when another writer already owns the name
+        (how leader-election claims stay race-free: exactly one racer
+        links its complete record under ``claim-<epoch>``).
+
+    Returns True when this call published the file.
     """
     path = str(path)
-    tmp = f"{path}.tmp.{os.getpid()}"
+    # pid AND thread id: two threads of one process publishing the same
+    # target (heartbeat + state-change announce, claim racers in tests)
+    # must not share a temp file — interleaved writes into one fd pair
+    # would publish a hybrid
+    tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
     fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o644)
     try:
         with os.fdopen(fd, "wb") as f:
             f.write(data)
             f.flush()
             os.fsync(f.fileno())
-        os.rename(tmp, path)
+        if exclusive:
+            try:
+                os.link(tmp, path)
+            except FileExistsError:
+                return False
+            finally:
+                os.unlink(tmp)
+        else:
+            os.rename(tmp, path)
     except BaseException:
         try:
             os.unlink(tmp)
@@ -168,6 +193,7 @@ def atomic_publish(path: str, data: bytes) -> None:
             pass
         raise
     fsync_dir(os.path.dirname(path) or ".")
+    return True
 
 
 def append_open(path: str):

@@ -268,6 +268,99 @@ class RecoveryManager:
         self.finish_boot(warmup=warmup, seal=seal)
         return graph
 
+    def adopt(self, graph, applied_lsn: int,
+              warmup: Optional[Callable] = None, seal: bool = False) -> int:
+        """Promotion boot: take ownership of a WAL this process has been
+        *following*, not writing.
+
+        A promoted follower already holds a nearly-current graph (the
+        shipped tail folded through ``applied_lsn``), so re-restoring
+        the checkpoint would throw that warmth away.  Opening the log is
+        the ownership handover — :class:`WriteAheadLog` resumes the
+        append cursor and clears the dead leader's torn tail exactly as
+        a same-process restart would — then only the records *past* the
+        follower's applied watermark are folded, abort-aware (two
+        passes, same as :meth:`finish_boot`).
+
+        One divergence is unrecoverable by folding: an abort whose
+        target is ``<= applied_lsn`` means the dead leader nacked a
+        record this follower already applied (a late abort that crossed
+        the failover).  Un-applying is not a graph operation, so that
+        path falls back to a full checkpoint boot — correctness over
+        warmth, and ``recovery_adopt_fallbacks_total`` says it
+        happened.  Either way the manager lands on ``serving``; the
+        caller re-reads ``self.graph`` (the fallback replaces it).
+        Returns the number of records folded/replayed.
+        """
+        from ..stream.compactor import compact
+
+        self._boot_t0 = time.perf_counter()
+        self._set_state("booting", stale=True)
+        os.makedirs(self.wal_dir, exist_ok=True)
+        os.makedirs(self.ckpt_dir, exist_ok=True)
+        wal = WriteAheadLog(self.wal_dir, **self._wal_kwargs)
+        applied_lsn = int(applied_lsn)
+        tail = []
+        aborted = set()
+        late_abort = None
+        for lsn, payload in wal.replay():
+            target = decode_abort(payload)
+            if target is not None:
+                if target <= applied_lsn:
+                    late_abort = (lsn, target)
+                    break
+                aborted.add(target)
+                continue
+            if lsn <= applied_lsn:
+                continue
+            tail.append((lsn, payload))
+        if late_abort is not None:
+            telemetry.counter("recovery_adopt_fallbacks_total").inc()
+            log.warning(
+                "late abort at lsn %d targets already-applied lsn %d; "
+                "adopted graph is ahead of the durable log — falling "
+                "back to checkpoint boot", *late_abort)
+            wal.close()
+            self.boot_degraded()
+            return self.finish_boot(warmup=warmup, seal=seal)
+        # quiverlint: ignore[QT008] -- promotion handover: set once here,
+        # before any lane or checkpointer exists for this manager
+        self.wal = wal
+        self.graph = graph  # quiverlint: ignore[QT008] -- same: adopt-once
+        with self._lock:
+            self._ckpt = load_checkpoint(self.ckpt_dir)
+        self._set_state("replaying", stale=True)
+        replayed = skipped = 0
+        for lsn, payload in tail:
+            if lsn in aborted:
+                telemetry.counter("recovery_replay_aborted_total").inc()
+                continue
+            try:
+                op, src, dst, ts = decode_edge_op(payload)
+            except WALError as e:
+                log.warning("undecodable WAL record at lsn %d: %s", lsn, e)
+                skipped += 1
+                continue
+            self._apply_replayed(op, src, dst, ts, compact)
+            replayed += 1
+        if replayed:
+            telemetry.counter("recovery_replay_records_total").inc(replayed)
+        if skipped:
+            telemetry.counter("recovery_replay_skipped_total").inc(skipped)
+        with self._lock:
+            self._replayed = replayed
+        self._set_state("warming", stale=False)
+        if warmup is not None:
+            warmup(self.graph)
+        if seal:
+            from .registry import get_program_registry
+
+            get_program_registry().seal()
+        self._boot_seconds = time.perf_counter() - self._boot_t0
+        telemetry.gauge("recovery_boot_seconds").set(self._boot_seconds)
+        self._set_state("serving", stale=False)
+        return replayed
+
     def _apply_replayed(self, op, src, dst, ts, compact) -> None:
         graph = self.graph
         if op == "add":

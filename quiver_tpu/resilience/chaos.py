@@ -206,7 +206,8 @@ def point(name: str) -> InjectionPoint:
 # arm them by name before any fleet module is imported — a seeded run
 # replays byte-identically whether the plan or the fleet loads first
 FLEET_POINTS = ("fleet.route", "fleet.ship", "fleet.join",
-                "fleet.serve")
+                "fleet.serve", "fleet.election.claim",
+                "fleet.walstream.send", "fleet.walstream.recv")
 for _name in FLEET_POINTS:
     point(_name)
 del _name

@@ -596,3 +596,103 @@ class TestFleetChaosHarness:
         assert report["rejoin"]["within_bound"] is True
         # the non-latency acceptance criteria all hold
         assert [f for f in check(report) if "p99" not in f] == []
+
+
+# ------------------------------------------- fleet autonomy satellites
+class TestMembershipAutonomy:
+    def test_leader_epoch_wins_and_conflict_counted(self, tmp_path):
+        """Split-brain window: a deposed leader's still-fresh record
+        must lose to the successor's higher epoch, and the overlap must
+        be observable."""
+        d = MembershipDirectory(str(tmp_path), heartbeat_timeout_s=5.0)
+        d.announce(ReplicaInfo("old", state="serving", role="leader",
+                               epoch=3))
+        d.announce(ReplicaInfo("new", state="serving", role="leader",
+                               epoch=4))
+        before = counter_value("fleet_leader_conflicts_total")
+        leader = d.leader()
+        assert leader.replica_id == "new"
+        assert counter_value("fleet_leader_conflicts_total") == before + 1
+        # single fresh leader: no conflict tick
+        d.deregister("old")
+        mid = counter_value("fleet_leader_conflicts_total")
+        assert d.leader().replica_id == "new"
+        assert counter_value("fleet_leader_conflicts_total") == mid
+
+    def test_epoch_roundtrip_and_legacy_default(self):
+        info = ReplicaInfo("r0", epoch=7)
+        assert ReplicaInfo.from_dict(info.to_dict()).epoch == 7
+        legacy = info.to_dict()
+        legacy.pop("epoch")  # a record from a pre-election build
+        assert ReplicaInfo.from_dict(legacy).epoch == -1
+
+    def test_record_unlinked_between_listdir_and_open(self, tmp_path,
+                                                      monkeypatch):
+        """Satellite: a record deregistered between the directory scan's
+        listdir and its open must be skipped and counted, never fatal."""
+        d = MembershipDirectory(str(tmp_path), heartbeat_timeout_s=5.0)
+        d.announce(ReplicaInfo("real", state="serving"))
+        real_listdir = os.listdir
+
+        def ghost_listdir(path):
+            return list(real_listdir(path)) + ["replica-ghost.json"]
+
+        monkeypatch.setattr(os, "listdir", ghost_listdir)
+        before = counter_value("fleet_membership_parse_errors_total")
+        out = d.replicas()
+        assert [r.replica_id for r in out] == ["real"]
+        assert counter_value(
+            "fleet_membership_parse_errors_total") == before + 1
+
+
+class TestFleetAutonomySatellites:
+    def test_draining_healthz_is_503_with_state(self, fleet):
+        """Satellite: /healthz during drain answers 503 with the
+        draining state in the body, so load balancers depool while
+        operators still see a live, finishing process."""
+        import urllib.error
+
+        leader = fleet.spawn("r0", "leader")
+        srv = leader.expose_metrics()
+        leader.drain(timeout=5)
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/healthz", timeout=5)
+        assert exc.value.code == 503
+        body = json.loads(exc.value.read())
+        assert body["state"] == "draining"
+        assert body["ready"] is False
+
+    def test_simultaneous_join_and_drain_consistent(self, fleet):
+        """Satellite: a join stretched across a concurrent drain leaves
+        router eligibility and ring membership consistent — the joiner
+        in, the drained member out, nothing half-present."""
+        import threading
+
+        leader = fleet.spawn("r0", "leader")
+        leader.manager.checkpoint(timeout=10)
+        f1 = fleet.spawn("r1", "follower")
+        router = fleet.router()
+        router.refresh(force=True)
+        assert sorted(router.ring.members) == ["r0", "r1"]
+        # stretch r2's join window across r1's drain
+        chaos.install(chaos.ChaosPlan(seed=3).delay(
+            "fleet.join", delay_s=0.3, times=1))
+        joined = {}
+
+        def join():
+            joined["rep"] = fleet.spawn("r2", "follower")
+
+        t = threading.Thread(target=join)
+        t.start()
+        f1.drain(timeout=5)
+        t.join(timeout=30)
+        assert "rep" in joined and joined["rep"].state == "serving"
+        assert fleet.directory.get("r1") is None
+        router.refresh(force=True)
+        assert sorted(router.ring.members) == ["r0", "r2"]
+        with router._lock:
+            eligible = sorted(router._eligible)
+        assert eligible == ["r0", "r2"]
+        for i in range(8):
+            assert router.request([i])["replica"] in ("r0", "r2")
